@@ -1,0 +1,27 @@
+"""llmss_tpu — a TPU-native tensor-parallel LLM serving framework.
+
+Re-designed from scratch for TPU (JAX/XLA/pjit/Pallas) with the capabilities of
+the reference `llmss` framework (PyTorch + NCCL, see SURVEY.md): tensor-parallel
+inference of HuggingFace causal LMs with lazy per-shard safetensors loading, a
+CLI generation driver, and a producer/broker/consumer serving stack.
+
+Architecture (single-controller JAX, not SPMD-with-rank-0-driver):
+
+- ``llmss_tpu.parallel``: device mesh over ICI/DCN (replaces
+  reference ``utils/dist.py`` process groups), sharding specs, long-context
+  sequence parallelism.
+- ``llmss_tpu.weights``: HF hub resolution + per-shard safetensors slice reads
+  into ``NamedSharding``-ed arrays (replaces ``utils/hub.py`` /
+  ``utils/weights.py``).
+- ``llmss_tpu.ops``: tensor-parallel layer library as pure, sharding-annotated
+  functions (replaces ``utils/layers.py``).
+- ``llmss_tpu.models``: model zoo (GPT-J, GPT-BigCode, GPT-2, Llama) as pure
+  forward functions over parameter pytrees (replaces ``custom_modeling/``).
+- ``llmss_tpu.engine``: jitted prefill + decode with a preallocated
+  static-shape KV cache and on-device sampling (replaces the
+  ``generate.py`` decode loops).
+- ``llmss_tpu.serve``: producer / broker / consumer serving stack with
+  request-id correlation (replaces ``poc-server/producer-consumer``).
+"""
+
+__version__ = "0.1.0"

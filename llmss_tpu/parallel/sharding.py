@@ -1,0 +1,50 @@
+"""Sharding-spec helpers shared by the layer library and engine.
+
+The reference decides sharding imperatively at load time inside each layer's
+``load()`` (``utils/weights.py:72-115``). Here sharding is declarative: every
+parameter pytree has a parallel tree of ``PartitionSpec``s, and activations are
+constrained at layer boundaries with ``with_sharding_constraint`` so XLA GSPMD
+inserts exactly the Megatron collectives the reference issues by hand
+(``lax.psum`` where the reference calls ``all_reduce``, ``all_gather`` for the
+vocab-parallel head — see SURVEY.md §2.8 census).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from llmss_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
+
+# Canonical activation specs.
+def act_spec(*, seq_sharded: bool = False) -> P:
+    """[batch, seq, hidden] activations: batch over dp, optionally seq over sp."""
+    return P(AXIS_DP, AXIS_SP if seq_sharded else None, None)
+
+
+def logits_spec() -> P:
+    """[batch, seq, vocab] logits: vocab replicated after the head gather."""
+    return P(AXIS_DP, None, None)
+
+
+def named(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def tree_named(mesh: Mesh, spec_tree: Any) -> Any:
+    """Map a PartitionSpec pytree to a NamedSharding pytree."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def constrain(x, spec: P):
+    """``with_sharding_constraint`` that is a no-op outside jit/mesh context."""
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        return x

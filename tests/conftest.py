@@ -1,0 +1,36 @@
+"""Test harness: force a virtual 8-device CPU mesh before JAX initializes.
+
+This is the TPU-native analogue of the reference's ``FakeGroup`` /``DEBUG=1``
+testing affordance (``utils/dist.py:14-37,62-63``): the same TP program runs on
+any dev box, but here the collectives are *real* (XLA CPU collectives over 8
+virtual devices) rather than no-ops, so sharded numerics are actually tested.
+"""
+
+import os
+
+# XLA flags must be set before the CPU backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep CPU compile times sane on small test shapes.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize imports jax at interpreter startup with
+# JAX_PLATFORMS pointing at the real TPU platform, so the env var alone is
+# read too early to help — override via config (backends are not yet
+# initialized at conftest import time).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
