@@ -1,0 +1,15 @@
+"""Weight resolution and sharded loading.
+
+TPU-native replacement for the reference's L1 layer
+(``utils/hub.py`` + ``utils/weights.py``): HF-hub/local safetensors file
+resolution, then per-device sliced reads assembled directly into
+``NamedSharding``-ed ``jax.Array``s — each host/device reads only its own
+shard bytes, like the reference's per-rank ``get_slice`` reads
+(``weights.py:72-95``), but driven by a declarative ``PartitionSpec`` instead
+of per-layer imperative code.
+"""
+
+from llmss_tpu.weights.hub import download_weights, weight_files
+from llmss_tpu.weights.loader import CheckpointShards
+
+__all__ = ["CheckpointShards", "download_weights", "weight_files"]

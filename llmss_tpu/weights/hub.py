@@ -1,0 +1,188 @@
+"""Checkpoint file resolution: local dirs, HF cache, hub download.
+
+Capability parity with the reference's ``utils/hub.py`` (163 LoC):
+
+- ``weight_files``   ≙ ``hub.py:77-118``  (local glob → cache resolution)
+- ``weight_hub_files`` ≙ ``hub.py:19-39`` (hub listing, ``.bin``→``.safetensors``
+  name fallback — with the reference's ``lstrip("pytorch_")`` character-set
+  bug (``hub.py:92-96``) fixed via ``removeprefix``)
+- ``try_to_load_from_cache`` ≙ ``hub.py:42-74``
+- ``download_weights`` ≙ ``hub.py:121-163`` (sequential, retry with backoff,
+  log-parseable progress lines)
+
+Env vars honored, as in the reference: ``WEIGHTS_CACHE_OVERRIDE`` (flat dir
+that short-circuits cache layout traversal, ``hub.py:16,98-105``) and
+``HUGGINGFACE_HUB_CACHE``/``HF_HOME`` (via huggingface_hub itself).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from pathlib import Path
+
+logger = logging.getLogger("llmss_tpu.weights")
+
+WEIGHTS_CACHE_OVERRIDE = os.environ.get("WEIGHTS_CACHE_OVERRIDE", None)
+
+
+class EntryNotFoundError(RuntimeError):
+    pass
+
+
+class LocalEntryNotFoundError(EntryNotFoundError):
+    pass
+
+
+def weight_hub_files(
+    model_id: str, revision: str | None = None, extension: str = ".safetensors"
+) -> list[str]:
+    """List checkpoint filenames on the hub for ``model_id``.
+
+    Falls back to rewriting ``.bin`` names to ``.safetensors`` when the repo
+    has no native safetensors export (reference behavior, ``hub.py:86-96``).
+    """
+    from huggingface_hub import HfApi
+
+    api = HfApi()
+    info = api.model_info(model_id, revision=revision)
+    filenames = [s.rfilename for s in info.siblings]
+    files = [f for f in filenames if f.endswith(extension)]
+    if not files and extension == ".safetensors":
+        bins = [f for f in filenames if f.endswith(".bin")]
+        # `pytorch_model.bin` → `model.safetensors` naming convention.
+        files = [
+            Path(f).name.removeprefix("pytorch_").replace(".bin", extension)
+            for f in bins
+        ]
+    if not files:
+        raise EntryNotFoundError(
+            f"No {extension} weights found for model {model_id}"
+        )
+    return files
+
+
+def try_to_load_from_cache(
+    model_id: str, revision: str | None, filename: str
+) -> Path | None:
+    """Resolve ``filename`` inside the local HF cache without any network.
+
+    Re-implements the refs → snapshot-sha → file traversal the reference does
+    (``hub.py:42-74``) so resolution works offline.
+    """
+    if revision is None:
+        revision = "main"
+    from huggingface_hub.constants import HF_HUB_CACHE
+
+    object_id = model_id.replace("/", "--")
+    repo_cache = Path(HF_HUB_CACHE) / f"models--{object_id}"
+    if not repo_cache.is_dir():
+        return None
+    refs_dir = repo_cache / "refs"
+    snapshots_dir = repo_cache / "snapshots"
+    if refs_dir.is_dir() and (refs_dir / revision).is_file():
+        revision = (refs_dir / revision).read_text().strip()
+    if not snapshots_dir.is_dir():
+        return None
+    snapshot = snapshots_dir / revision
+    if not snapshot.is_dir():
+        return None
+    target = snapshot / filename
+    return target if target.is_file() else None
+
+
+def weight_files(
+    model_id: str, revision: str | None = None, extension: str = ".safetensors"
+) -> list[Path]:
+    """Resolve checkpoint files to local paths (no downloads here).
+
+    Order, matching ``hub.py:77-118``: local directory glob →
+    ``WEIGHTS_CACHE_OVERRIDE`` flat dir → HF cache traversal; raises
+    ``LocalEntryNotFoundError`` telling the user to run ``download_weights``
+    first if anything is missing.
+    """
+    p = Path(model_id)
+    if p.exists() and p.is_dir():
+        files = sorted(p.glob(f"*{extension}"))
+        if not files:
+            raise FileNotFoundError(
+                f"No local weights found in {model_id} with extension "
+                f"{extension}"
+            )
+        return files
+
+    filenames = weight_hub_files(model_id, revision, extension)
+
+    if WEIGHTS_CACHE_OVERRIDE is not None:
+        files = []
+        for fname in filenames:
+            path = Path(WEIGHTS_CACHE_OVERRIDE) / fname
+            if not path.is_file():
+                raise FileNotFoundError(
+                    f"File {path} not found in {WEIGHTS_CACHE_OVERRIDE}"
+                )
+            files.append(path)
+        return files
+
+    files = []
+    for fname in filenames:
+        cached = try_to_load_from_cache(model_id, revision, fname)
+        if cached is None:
+            raise LocalEntryNotFoundError(
+                f"File {fname} of model {model_id} not found in "
+                f"{os.environ.get('HUGGINGFACE_HUB_CACHE', 'the local cache')}. "
+                f"Please run `llmss-download {model_id}` first."
+            )
+        files.append(cached)
+    return files
+
+
+def download_weights(
+    model_id: str,
+    revision: str | None = None,
+    extension: str = ".safetensors",
+    max_retries: int = 5,
+    backoff_s: float = 5.0,
+) -> list[Path]:
+    """Sequentially download checkpoint files with retry + progress logs.
+
+    Mirrors ``hub.py:121-163``: per-file retries with fixed backoff, and
+    machine-parseable progress lines (``{"file": ..., "elapsed": ...,
+    "eta": ...}``) instead of tqdm.
+    """
+    from huggingface_hub import hf_hub_download
+
+    filenames = weight_hub_files(model_id, revision, extension)
+    files: list[Path] = []
+    start = time.time()
+    for i, fname in enumerate(filenames):
+        last_err: Exception | None = None
+        for attempt in range(max_retries):
+            try:
+                local = hf_hub_download(
+                    model_id, filename=fname, revision=revision
+                )
+                files.append(Path(local))
+                last_err = None
+                break
+            except Exception as e:  # noqa: BLE001 — retry any transport error
+                last_err = e
+                logger.warning(
+                    "download of %s failed (attempt %d/%d): %s",
+                    fname, attempt + 1, max_retries, e,
+                )
+                time.sleep(backoff_s)
+        if last_err is not None:
+            raise last_err
+        elapsed = time.time() - start
+        eta = (elapsed / (i + 1)) * (len(filenames) - (i + 1))
+        logger.info(
+            "%s",
+            json.dumps(
+                {"file": fname, "n": i + 1, "total": len(filenames),
+                 "elapsed_s": round(elapsed, 1), "eta_s": round(eta, 1)}
+            ),
+        )
+    return files

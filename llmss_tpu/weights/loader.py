@@ -1,0 +1,215 @@
+"""Lazy sharded checkpoint loader: safetensors slices → NamedSharding arrays.
+
+TPU-native replacement for the reference's ``Weights`` class
+(``utils/weights.py``). The reference reads, per rank, only that rank's slice
+of each tensor (``get_partial_sharded``, ``weights.py:72-95``) and the
+consuming layer decides the shard dim imperatively. Here the same
+minimal-bytes property is driven declaratively: ``get_array(name, mesh, spec)``
+uses ``jax.make_array_from_callback`` so each *addressable device shard*
+triggers exactly one sliced read of its own bytes — on a multi-host pod every
+host therefore touches only its shard bytes, like the reference, but for any
+``PartitionSpec`` (not just dim-0/dim-1).
+
+API parity map (reference → here):
+
+- ``Weights.routing`` duplicate detection (``weights.py:18-24``) → ctor
+- ``aliases`` (``weights.py:41-50``) → ctor ``aliases=``
+- ``get_shape`` (``:58``) → ``get_shape``
+- ``get_tensor`` (``:61-70``) → ``get_tensor``
+- ``get_partial_sharded``/``get_sharded`` (``:72-106``) → ``get_array`` with a
+  sharded spec (divisibility checked by JAX sharding itself; uneven shards are
+  padded at a higher level, see the vocab-parallel embedding)
+- ``get_multi_weights_col`` fused-QKV concat loads (``:108-111``) →
+  ``get_concat_array``
+- dtype cast with int guard for quantized tensors (``:90-93``) → ``_cast``
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from safetensors import safe_open
+
+
+class CheckpointShards:
+    """Read-only view over a set of safetensors files.
+
+    ``dtype`` is the target compute dtype for floating-point tensors;
+    integer tensors (quantization scales/indices) are left untouched, like the
+    reference's int32 gptq guard (``weights.py:90-93``).
+    """
+
+    def __init__(
+        self,
+        filenames: Sequence[str | Path],
+        dtype=None,
+        aliases: dict[str, list[str]] | None = None,
+    ):
+        routing: dict[str, Path] = {}
+        for filename in filenames:
+            filename = Path(filename)
+            with safe_open(filename, framework="numpy") as f:
+                for k in f.keys():
+                    if k in routing:
+                        raise RuntimeError(
+                            f"Key {k} was found in multiple files: "
+                            f"{filename} and {routing[k]}"
+                        )
+                    routing[k] = filename
+        self.routing = routing
+        self.dtype = dtype
+        self.aliases = aliases or {}
+        self._handles: dict[Path, object] = {}
+
+    # -- resolution ---------------------------------------------------------
+
+    def _resolve(self, name: str) -> str:
+        if name in self.routing:
+            return name
+        for alias in self.aliases.get(name, []):
+            if alias in self.routing:
+                return alias
+        raise KeyError(f"weight {name} not found (aliases tried)")
+
+    def _handle(self, name: str):
+        filename = self.routing[self._resolve(name)]
+        if filename not in self._handles:
+            self._handles[filename] = safe_open(filename, framework="numpy")
+        return self._handles[filename]
+
+    def __contains__(self, name: str) -> bool:
+        try:
+            self._resolve(name)
+            return True
+        except KeyError:
+            return False
+
+    def keys(self):
+        return self.routing.keys()
+
+    # -- host-side reads ----------------------------------------------------
+
+    def get_shape(self, name: str) -> tuple[int, ...]:
+        return tuple(self._handle(name).get_slice(self._resolve(name)).get_shape())
+
+    def _cast(self, x: np.ndarray) -> np.ndarray:
+        if self.dtype is None:
+            return x
+        is_float = np.issubdtype(x.dtype, np.floating) or str(x.dtype) in (
+            "bfloat16", "float8_e4m3fn", "float8_e5m2",
+        )
+        # Integer tensors (e.g. quantization indices) pass through, matching
+        # the reference's int32 gptq guard (weights.py:90-93).
+        return x.astype(self.dtype) if is_float else x
+
+    def get_tensor(self, name: str) -> np.ndarray:
+        x = self._handle(name).get_tensor(self._resolve(name))
+        return self._cast(x)
+
+    def read_slice(
+        self, name: str, index: tuple[slice, ...], transpose: bool = False
+    ) -> np.ndarray:
+        """Read only ``index`` bytes of tensor ``name``.
+
+        With ``transpose=True`` the tensor is treated as its 2D transpose:
+        ``index`` addresses the transposed view, and only the corresponding
+        source bytes are read. This converts torch ``nn.Linear`` checkpoints
+        ([out, in]) to the x@W layout ([in, out]) without a full-tensor read.
+        """
+        sl = self._handle(name).get_slice(self._resolve(name))
+        if transpose:
+            index = tuple(reversed(index))
+            chunk = sl[index]
+            chunk = np.asarray(chunk).T
+        else:
+            chunk = np.asarray(sl[index])
+        return self._cast(chunk)
+
+    # -- device loads -------------------------------------------------------
+
+    def get_array(
+        self,
+        name: str,
+        mesh: Mesh,
+        spec: P = P(),
+        transpose: bool = False,
+    ) -> jax.Array:
+        """Load ``name`` as a global array sharded by ``spec`` over ``mesh``.
+
+        Each addressable shard reads only its own slice from disk
+        (≙ ``get_partial_sharded``, ``weights.py:72-95``, generalized to any
+        PartitionSpec).
+        """
+        shape = self.get_shape(name)
+        if transpose:
+            if len(shape) != 2:
+                raise ValueError("transpose load requires a 2D tensor")
+            shape = tuple(reversed(shape))
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            shape,
+            sharding,
+            lambda index: self.read_slice(name, index, transpose=transpose),
+        )
+
+    def get_concat_array(
+        self,
+        names: Sequence[str],
+        axis: int,
+        mesh: Mesh,
+        spec: P = P(),
+        transpose: bool = False,
+    ) -> jax.Array:
+        """Load several tensors concatenated along ``axis``, sharded by ``spec``.
+
+        ≙ ``get_multi_weights_col`` fused QKV loads (``weights.py:108-111``):
+        the reference concatenates each rank's column shards; here the
+        concatenation is expressed in global coordinates and each device shard
+        reads only the overlapping byte ranges of each source tensor.
+        """
+        shapes = []
+        for n in names:
+            s = self.get_shape(n)
+            if transpose:
+                s = tuple(reversed(s))
+            shapes.append(s)
+        base = shapes[0]
+        for s in shapes[1:]:
+            if len(s) != len(base) or any(
+                s[d] != base[d] for d in range(len(base)) if d != axis
+            ):
+                raise ValueError(f"incompatible concat shapes {shapes}")
+        sizes = [s[axis] for s in shapes]
+        offsets = np.concatenate([[0], np.cumsum(sizes)])
+        global_shape = list(base)
+        global_shape[axis] = int(offsets[-1])
+
+        def callback(index: tuple[slice, ...]) -> np.ndarray:
+            ax_sl = index[axis]
+            start = ax_sl.start or 0
+            stop = ax_sl.stop if ax_sl.stop is not None else global_shape[axis]
+            parts = []
+            for n, off, size in zip(names, offsets[:-1], sizes):
+                lo = max(start, int(off))
+                hi = min(stop, int(off) + size)
+                if lo >= hi:
+                    continue
+                local = list(index)
+                local[axis] = slice(lo - int(off), hi - int(off))
+                parts.append(
+                    self.read_slice(n, tuple(local), transpose=transpose)
+                )
+            return np.concatenate(parts, axis=axis)
+
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            tuple(global_shape), sharding, callback
+        )
+
+    def close(self):
+        self._handles.clear()

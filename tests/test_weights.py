@@ -1,0 +1,92 @@
+"""Weights layer: routing, sliced loads, concat loads, transpose loads."""
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from safetensors.numpy import save_file
+
+from llmss_tpu.parallel import AXIS_TP, MeshPlan, make_mesh
+from llmss_tpu.weights import CheckpointShards, weight_files
+
+
+@pytest.fixture(scope="module")
+def ckpt_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ckpt")
+    rng = np.random.default_rng(0)
+    save_file(
+        {
+            "wte": rng.normal(size=(32, 16)).astype(np.float32),
+            "q": rng.normal(size=(16, 16)).astype(np.float32),
+            "k": rng.normal(size=(16, 16)).astype(np.float32),
+            "v": rng.normal(size=(16, 16)).astype(np.float32),
+            "idx": np.arange(10, dtype=np.int32),
+        },
+        str(d / "model-00001.safetensors"),
+    )
+    save_file(
+        {"ln.weight": np.ones(16, dtype=np.float32)},
+        str(d / "model-00002.safetensors"),
+    )
+    return d
+
+
+def test_weight_files_local_dir(ckpt_dir):
+    files = weight_files(str(ckpt_dir))
+    assert len(files) == 2
+
+
+def test_routing_duplicate_key_raises(tmp_path):
+    save_file({"a": np.zeros(2, np.float32)}, str(tmp_path / "x.safetensors"))
+    save_file({"a": np.zeros(2, np.float32)}, str(tmp_path / "y.safetensors"))
+    with pytest.raises(RuntimeError, match="multiple files"):
+        CheckpointShards(sorted(tmp_path.glob("*.safetensors")))
+
+
+def test_get_tensor_and_aliases(ckpt_dir):
+    ckpt = CheckpointShards(
+        weight_files(str(ckpt_dir)),
+        aliases={"transformer.wte": ["wte"]},
+    )
+    np.testing.assert_array_equal(
+        ckpt.get_tensor("transformer.wte"), ckpt.get_tensor("wte")
+    )
+    assert "transformer.wte" in ckpt and "missing" not in ckpt
+    assert ckpt.get_shape("wte") == (32, 16)
+
+
+def test_int_tensors_skip_cast(ckpt_dir):
+    ckpt = CheckpointShards(weight_files(str(ckpt_dir)), dtype=np.float16)
+    assert ckpt.get_tensor("idx").dtype == np.int32
+    assert ckpt.get_tensor("q").dtype == np.float16
+
+
+def test_sharded_load_matches_full(ckpt_dir, devices):
+    mesh = make_mesh(MeshPlan(tp=8))
+    ckpt = CheckpointShards(weight_files(str(ckpt_dir)))
+    full = ckpt.get_tensor("wte")
+    arr = ckpt.get_array("wte", mesh, P(AXIS_TP, None))
+    np.testing.assert_array_equal(np.asarray(arr), full)
+    # Each shard holds 32/8 rows.
+    shard = arr.addressable_shards[0]
+    assert shard.data.shape == (4, 16)
+
+
+def test_transpose_load(ckpt_dir, devices):
+    mesh = make_mesh(MeshPlan(tp=8))
+    ckpt = CheckpointShards(weight_files(str(ckpt_dir)))
+    full = ckpt.get_tensor("q")
+    arr = ckpt.get_array("q", mesh, P(None, AXIS_TP), transpose=True)
+    np.testing.assert_array_equal(np.asarray(arr), full.T)
+
+
+def test_concat_load_fused_qkv(ckpt_dir, devices):
+    mesh = make_mesh(MeshPlan(tp=8))
+    ckpt = CheckpointShards(weight_files(str(ckpt_dir)))
+    ref = np.concatenate(
+        [ckpt.get_tensor(n) for n in ("q", "k", "v")], axis=0
+    )
+    arr = ckpt.get_concat_array(("q", "k", "v"), 0, mesh, P(AXIS_TP, None))
+    np.testing.assert_array_equal(np.asarray(arr), ref)
+    assert arr.shape == (48, 16)
+    # Sharded on the concat axis: 6 rows per device, crossing source borders.
+    assert arr.addressable_shards[0].data.shape == (6, 16)
