@@ -1,0 +1,45 @@
+"""Tensor-parallel layer library (pure functions + declarative sharding).
+
+TPU-native replacement for the reference's ``utils/layers.py``: instead of
+``nn.Module``s that hide ``torch.distributed`` collectives inside ``forward``
+(``layers.py:156-179``: RowLinear allreduce; ``:79-135``: Head all-gather;
+``:182-214``: vocab-parallel embedding psum), layers here are pure jnp
+functions whose parameters carry ``PartitionSpec``s; XLA GSPMD compiles the
+identical Megatron collectives (psum for row-parallel matmuls and the
+vocab-partitioned embedding, all-gather for the head) onto the ICI mesh.
+
+Loaders mirror the reference's per-layer ``load(config, prefix, weights)``
+classmethods (column/row/fused-QKV/head/embedding), reading only each device's
+shard bytes via ``CheckpointShards``.
+"""
+
+from llmss_tpu.ops.layers import (
+    LinearParams,
+    NormParams,
+    dense,
+    embedding,
+    layer_norm,
+    lm_head,
+    load_embedding,
+    load_linear,
+    load_norm,
+    rms_norm,
+)
+from llmss_tpu.ops.attention import attention, make_causal_mask
+from llmss_tpu.ops.sampling import sample
+
+__all__ = [
+    "LinearParams",
+    "NormParams",
+    "attention",
+    "dense",
+    "embedding",
+    "layer_norm",
+    "lm_head",
+    "load_embedding",
+    "load_linear",
+    "load_norm",
+    "make_causal_mask",
+    "rms_norm",
+    "sample",
+]

@@ -1,0 +1,68 @@
+"""Attention core: MHA / GQA / MQA with fp32 softmax islands.
+
+Replaces the reference's per-model attention math (``gptj_modeling.py:128-169``
+fp32 masked softmax; ``gpt_bigcode_modeling.py:49-72`` jit-scripted fused
+upcast softmax + ``:170-246`` MQA baddbmm path). On TPU none of this needs
+hand-fusion — a single einsum→mask→softmax→einsum chain compiles to fused MXU
+ops — but the numerics contract is kept: attention probabilities are computed
+in fp32 regardless of compute dtype (the reference's ``attn_weights`` fp32
+islands), then cast back.
+
+Head layout: ``[batch, seq, heads, head_dim]`` (head_dim rides the 128-lane
+minor dimension). GQA/MQA are the general case: ``n_kv_heads`` may be 1 (MQA —
+the reference replicates the single KV head across TP ranks,
+``gpt_bigcode_modeling.py:150-155``; here the same thing falls out of a
+replicated sharding spec on the KV projection).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def make_causal_mask(
+    q_positions: jax.Array,  # [B, S] int — absolute position of each query
+    kv_positions: jax.Array,  # [B, T] int — absolute position of each cache slot
+    kv_valid: jax.Array,  # [B, T] bool — slot holds a real token
+) -> jax.Array:
+    """Boolean [B, S, T] mask: query may attend to valid slots at <= position.
+
+    Replaces the reference's precomputed tril buffer
+    (``gptj_modeling.py:55-61``) with position arithmetic that works for both
+    contiguous prefill and ring-buffer decode, where cache slot order is not
+    position order.
+    """
+    return (kv_positions[:, None, :] <= q_positions[:, :, None]) & kv_valid[
+        :, None, :
+    ]
+
+
+def attention(
+    q: jax.Array,  # [B, S, Hq, D]
+    k: jax.Array,  # [B, T, Hkv, D]
+    v: jax.Array,  # [B, T, Hkv, D]
+    mask: jax.Array,  # [B, S, T] bool
+    *,
+    scale: float | None = None,
+) -> jax.Array:
+    """Scaled dot-product attention, grouped-query general case.
+
+    Returns [B, S, Hq, D] in q's dtype; softmax in fp32.
+    """
+    B, S, Hq, D = q.shape
+    Hkv = k.shape[2]
+    assert Hq % Hkv == 0, (Hq, Hkv)
+    G = Hq // Hkv
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+
+    qf = q.astype(jnp.float32).reshape(B, S, Hkv, G, D) * scale
+    kf = k.astype(jnp.float32)
+    logits = jnp.einsum("bskgd,btkd->bkgst", qf, kf)
+    logits = jnp.where(mask[:, None, None, :, :], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, S, Hq, D).astype(q.dtype)

@@ -1,0 +1,58 @@
+"""On-device token sampling: temperature → top-k → top-p → categorical.
+
+The reference constructs HF logits warpers but never applies them due to an
+inverted condition (``generate.py:120-124``, ``consumer_server.py:141-145`` —
+SURVEY.md §2.11.1), so its "sampling" is multinomial over raw-logit softmax.
+This module implements *correct* sampling as a deliberate behavior fix, with
+the conventional order (temperature first, then top-k, then top-p), entirely
+on device — no per-token host round-trip, which is what deletes the
+reference's per-token ``dist.broadcast`` (``generate.py:144``).
+
+All warper parameters are per-request arrays (dynamic under jit) so a batch
+can mix greedy and sampled requests — required for continuous batching.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample(
+    logits: jax.Array,  # [B, V] fp32
+    key: jax.Array,
+    *,
+    temperature: jax.Array,  # [B] f32; ignored where greedy
+    top_k: jax.Array,  # [B] int32; <=0 disables
+    top_p: jax.Array,  # [B] f32; 1.0 disables
+    greedy: jax.Array,  # [B] bool
+) -> jax.Array:
+    """Sample next token ids [B] int32.
+
+    Dynamic per-request top-k/top-p are implemented with one descending sort
+    (no static k), so a single compiled step serves any warper mix.
+    """
+    B, V = logits.shape
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    order = jnp.argsort(-scaled, axis=-1)
+    svals = jnp.take_along_axis(scaled, order, axis=-1)
+    probs = jax.nn.softmax(svals, axis=-1)
+    # Probability mass strictly before each sorted token: nucleus keeps the
+    # smallest prefix whose mass reaches top_p (always >= 1 token).
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    rank = jnp.arange(V, dtype=jnp.int32)[None, :]
+    k_eff = jnp.where(top_k <= 0, V, top_k).astype(jnp.int32)[:, None]
+    keep = (rank < k_eff) & (cum_before < top_p[:, None])
+    keep = keep.at[:, 0].set(True)
+    filtered = jnp.where(keep, svals, float(jnp.finfo(jnp.float32).min))
+
+    choice = jax.random.categorical(key, filtered, axis=-1)
+    sampled_tok = jnp.take_along_axis(
+        order, choice[:, None], axis=-1
+    )[:, 0].astype(jnp.int32)
+
+    return jnp.where(greedy, greedy_tok, sampled_tok)

@@ -116,13 +116,19 @@ def make_mesh(
     an explicit device list (used by tests to build submeshes).
     """
     plan = plan or MeshPlan()
+    # Auto axis types: the classic GSPMD model — parameters carry
+    # NamedShardings, activations get with_sharding_constraint hints, XLA
+    # propagates and inserts collectives. (JAX 0.9's default is the new
+    # Explicit sharding-in-types mode, which requires per-op out_sharding
+    # annotations; Auto is the mature path MaxText-class frameworks use.)
+    auto = (jax.sharding.AxisType.Auto,) * len(AXIS_ORDER)
     if devices is None:
         devices = jax.devices()
         dp, sp, tp = plan.resolve(len(devices))
-        return jax.make_mesh((dp, sp, tp), AXIS_ORDER)
+        return jax.make_mesh((dp, sp, tp), AXIS_ORDER, axis_types=auto)
     dp, sp, tp = plan.resolve(len(devices))
     arr = np.asarray(devices, dtype=object).reshape(dp, sp, tp)
-    return Mesh(arr, AXIS_ORDER)
+    return Mesh(arr, AXIS_ORDER, axis_types=auto)
 
 
 def default_compute_dtype() -> jnp.dtype:

@@ -1,0 +1,171 @@
+"""Layer library: sharded-vs-unsharded parity, attention, sampling."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from llmss_tpu.ops import attention, dense, embedding, layer_norm, lm_head, rms_norm, sample
+from llmss_tpu.ops.layers import LinearParams, NormParams, linear_specs
+from llmss_tpu.parallel import AXIS_TP, MeshPlan, make_mesh
+from llmss_tpu.parallel.sharding import tree_named
+
+
+@pytest.fixture(scope="module")
+def mesh(devices):
+    return make_mesh(MeshPlan(tp=8))
+
+
+def _place(mesh, params, specs):
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def test_column_then_row_parity(mesh):
+    """Megatron column→row pair equals unsharded two-layer MLP."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 4, 32)), jnp.float32)
+    w1 = jnp.asarray(rng.normal(size=(32, 64)), jnp.float32)
+    b1 = jnp.asarray(rng.normal(size=(64,)), jnp.float32)
+    w2 = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+    b2 = jnp.asarray(rng.normal(size=(32,)), jnp.float32)
+
+    ref = jax.nn.gelu(x @ w1 + b1) @ w2 + b2
+
+    col = _place(mesh, LinearParams(w1, b1), linear_specs("column"))
+    row = _place(mesh, LinearParams(w2, b2), linear_specs("row"))
+
+    @jax.jit
+    def f(x, col, row):
+        return dense(jax.nn.gelu(dense(x, col)), row)
+
+    out = f(x, col, row)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_vocab_parallel_embedding_and_head(mesh):
+    rng = np.random.default_rng(2)
+    table = jnp.asarray(rng.normal(size=(40, 16)), jnp.float32)  # 40 % 8 != 0
+    ids = jnp.asarray(rng.integers(0, 40, size=(2, 5)), jnp.int32)
+    ref_emb = jnp.take(table, ids, axis=0)
+    ref_logits = (ref_emb @ table.T).astype(jnp.float32)
+
+    sh_table = jax.device_put(table, NamedSharding(mesh, P(AXIS_TP, None)))
+    head = LinearParams(
+        jax.device_put(table.T, NamedSharding(mesh, P(None, AXIS_TP))), None
+    )
+
+    @jax.jit
+    def f(ids, table, head):
+        h = embedding(ids, table, one_hot=True)
+        return h, lm_head(h, head)
+
+    emb, logits = f(ids, sh_table, head)
+    np.testing.assert_allclose(np.asarray(emb), np.asarray(ref_emb), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), atol=1e-4
+    )
+
+
+def test_norms():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(2, 3, 8)), jnp.float32)
+    p = NormParams(
+        jnp.asarray(rng.normal(size=(8,)), jnp.float32),
+        jnp.asarray(rng.normal(size=(8,)), jnp.float32),
+    )
+    y = layer_norm(x, p, 1e-5)
+    ref = p.scale * (x - x.mean(-1, keepdims=True)) / jnp.sqrt(
+        x.var(-1, keepdims=True) + 1e-5
+    ) + p.bias
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5)
+
+    pr = NormParams(p.scale, None)
+    yr = rms_norm(x, pr, 1e-6)
+    refr = p.scale * x / jnp.sqrt((x * x).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(np.asarray(yr), np.asarray(refr), atol=1e-5)
+
+
+def test_attention_matches_naive_mha():
+    rng = np.random.default_rng(4)
+    B, S, H, D = 2, 6, 4, 8
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    pos = jnp.broadcast_to(jnp.arange(S), (B, S))
+    mask = (pos[:, None, :] <= pos[:, :, None])
+
+    out = attention(q, k, v, mask)
+
+    # naive reference
+    logits = jnp.einsum("bshd,bthd->bhst", q, k) / np.sqrt(D)
+    logits = jnp.where(mask[:, None], logits, -1e30)
+    ref = jnp.einsum("bhst,bthd->bshd", jax.nn.softmax(logits), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_attention_mqa_broadcasts_kv():
+    rng = np.random.default_rng(5)
+    B, S, H, D = 1, 4, 6, 8
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    k1 = jnp.asarray(rng.normal(size=(B, S, 1, D)), jnp.float32)
+    v1 = jnp.asarray(rng.normal(size=(B, S, 1, D)), jnp.float32)
+    pos = jnp.broadcast_to(jnp.arange(S), (B, S))
+    mask = pos[:, None, :] <= pos[:, :, None]
+
+    out_mqa = attention(q, k1, v1, mask)
+    out_rep = attention(
+        q, jnp.repeat(k1, H, 2), jnp.repeat(v1, H, 2), mask
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_mqa), np.asarray(out_rep), atol=1e-5
+    )
+
+
+def test_sampling_greedy_and_filters():
+    key = jax.random.key(0)
+    logits = jnp.asarray(
+        [[0.0, 1.0, 2.0, 3.0], [3.0, 2.0, 1.0, 0.0]], jnp.float32
+    )
+    tok = sample(
+        logits, key,
+        temperature=jnp.ones(2), top_k=jnp.zeros(2, jnp.int32),
+        top_p=jnp.ones(2), greedy=jnp.array([True, True]),
+    )
+    np.testing.assert_array_equal(np.asarray(tok), [3, 0])
+
+    # top_k=1 forces argmax even when sampling.
+    tok = sample(
+        logits, jax.random.key(1),
+        temperature=jnp.ones(2), top_k=jnp.ones(2, jnp.int32),
+        top_p=jnp.ones(2), greedy=jnp.array([False, False]),
+    )
+    np.testing.assert_array_equal(np.asarray(tok), [3, 0])
+
+    # tiny top_p keeps only the head of the nucleus.
+    tok = sample(
+        logits, jax.random.key(2),
+        temperature=jnp.ones(2), top_k=jnp.zeros(2, jnp.int32),
+        top_p=jnp.full(2, 1e-6), greedy=jnp.array([False, False]),
+    )
+    np.testing.assert_array_equal(np.asarray(tok), [3, 0])
+
+
+def test_sampling_distribution_sane():
+    # With temperature→0 sampling must concentrate on the argmax.
+    logits = jnp.asarray([[1.0, 5.0, 2.0, 0.0]], jnp.float32)
+    toks = [
+        int(
+            sample(
+                logits, jax.random.key(i),
+                temperature=jnp.full(1, 0.01),
+                top_k=jnp.zeros(1, jnp.int32),
+                top_p=jnp.ones(1),
+                greedy=jnp.array([False]),
+            )[0]
+        )
+        for i in range(10)
+    ]
+    assert toks == [1] * 10
