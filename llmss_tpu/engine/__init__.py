@@ -1,0 +1,15 @@
+"""Decode engine: static-shape KV cache, jitted prefill/decode, generation.
+
+TPU-native replacement for the reference's decode loops
+(``generate.py:99-190``, ``consumer_server.py:123-166``): instead of a
+Python-driven per-token loop with a concat-growing KV cache
+(``gptj_modeling.py:229-236``), per-token rank-0 sampling on host, and a NCCL
+broadcast of each sampled token (``generate.py:144``), generation here is a
+jitted prefill step plus a jitted single-token decode step over a
+**preallocated ring-buffer cache** with on-device sampling — zero per-token
+host↔device round trips beyond fetching the emitted token.
+"""
+
+from llmss_tpu.engine.cache import KVCache
+
+__all__ = ["KVCache"]

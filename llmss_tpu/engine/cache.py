@@ -1,0 +1,97 @@
+"""Preallocated ring-buffer KV cache as a sharded pytree.
+
+The reference grows the cache by concatenation every token
+(``gptj_modeling.py:229-236``) and, on overflow of ``n_positions``, trims to
+the last ``n-1`` entries host-side (``generate.py:132-142`` — SURVEY.md
+§2.11.2). Neither is jittable: XLA requires static shapes. Here the cache is a
+fixed ``[L, B, T, Hkv, D]`` buffer; each incoming token's KV is scattered into
+slot ``position % T``, and a per-slot ``positions`` array (−1 = empty) both
+validates slots and orders them for the causal mask — so overflow naturally
+degrades to the reference's sliding-window semantics, but in place, with
+donated buffers (no ``torch.cuda.empty_cache()`` workarounds,
+``generate.py:187``).
+
+Sharding: heads over ``tp`` when divisible (MHA/GQA); replicated for MQA —
+the same layout the reference engineers by hand (replicated single KV head,
+``gpt_bigcode_modeling.py:150-155``). Batch over ``dp``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from llmss_tpu.parallel.mesh import AXIS_DP, AXIS_TP
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # [L, B, T, Hkv, D]
+    v: jax.Array  # [L, B, T, Hkv, D]
+    positions: jax.Array  # [B, T] int32, -1 = empty slot
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def cache_specs(n_kv_heads: int, tp: int) -> KVCache:
+    """PartitionSpecs for the cache pytree."""
+    head_axis = AXIS_TP if n_kv_heads % tp == 0 else None
+    kv = P(None, AXIS_DP, None, head_axis, None)
+    return KVCache(k=kv, v=kv, positions=P(AXIS_DP, None))
+
+
+def init_cache(
+    mesh: Mesh,
+    *,
+    n_layers: int,
+    batch: int,
+    max_len: int,
+    n_kv_heads: int,
+    head_dim: int,
+    dtype=jnp.bfloat16,
+) -> KVCache:
+    specs = cache_specs(n_kv_heads, mesh.shape[AXIS_TP])
+    shape = (n_layers, batch, max_len, n_kv_heads, head_dim)
+
+    def zeros(spec, shape, dtype):
+        return jax.device_put(
+            jnp.zeros(shape, dtype), NamedSharding(mesh, spec)
+        )
+
+    return KVCache(
+        k=zeros(specs.k, shape, dtype),
+        v=zeros(specs.v, shape, dtype),
+        positions=zeros(specs.positions, (batch, max_len), jnp.int32) - 1,
+    )
+
+
+def write_positions(
+    cache_positions: jax.Array,  # [B, T]
+    q_positions: jax.Array,  # [B, S] absolute positions being written
+    slots: jax.Array,  # [B, S] slot index for each new token
+) -> jax.Array:
+    """Record the positions of newly written tokens (once per step, shared by
+    all layers)."""
+    B = cache_positions.shape[0]
+    b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    return cache_positions.at[b_idx, slots].set(q_positions.astype(jnp.int32))
+
+
+def write_layer(
+    k_cache: jax.Array,  # [B, T, Hkv, D] one layer's cache
+    v_cache: jax.Array,
+    k_new: jax.Array,  # [B, S, Hkv, D]
+    v_new: jax.Array,
+    slots: jax.Array,  # [B, S]
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter new KV into ring slots (per-batch-row scatter: rows may be at
+    different sequence offsets under continuous batching)."""
+    B = k_cache.shape[0]
+    b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    k_cache = k_cache.at[b_idx, slots].set(k_new.astype(k_cache.dtype))
+    v_cache = v_cache.at[b_idx, slots].set(v_new.astype(v_cache.dtype))
+    return k_cache, v_cache

@@ -1,0 +1,13 @@
+"""Model zoo: pure-function decoders over parameter pytrees.
+
+TPU-native replacement for the reference's ``custom_modeling/`` (GPT-J,
+GPT-BigCode), extended with GPT-2 and Llama for the BASELINE.md config ladder.
+All models share one unified decoder (``decoder.py``) driven by a
+``DecoderConfig``; per-model modules translate HF configs and checkpoint
+name layouts.
+"""
+
+from llmss_tpu.models.common import DecoderConfig
+from llmss_tpu.models.registry import MODEL_REGISTRY, config_from_hf, load_model
+
+__all__ = ["DecoderConfig", "MODEL_REGISTRY", "config_from_hf", "load_model"]

@@ -1,0 +1,66 @@
+"""Shared checkpoint-loading helpers for the model zoo.
+
+Per-layer tensors load as stacked ``[n_layers, ...]`` arrays (scan layout)
+with per-shard sliced reads; fused checkpoint tensors (GPT-2/BigCode
+``c_attn``) are split into Q/K/V via sub-range reads instead of the
+reference's full-tensor-then-slice (``gpt_bigcode_modeling.py:120-155``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+from llmss_tpu.ops.layers import LinearParams, NormParams
+from llmss_tpu.weights.loader import CheckpointShards
+
+
+def stacked_linear(
+    ckpt: CheckpointShards,
+    name_fn: Callable[[int], str],
+    n_layers: int,
+    mesh: Mesh,
+    w_spec: P,
+    b_spec: P | None,
+    *,
+    transpose: bool = True,
+    sub: tuple[int, int, int] | None = None,
+    bias: bool = True,
+) -> LinearParams:
+    """Load ``{prefix}.weight`` / ``.bias`` for all layers, stacked.
+
+    ``w_spec``/``b_spec`` are the *stacked* specs (leading layer axis).
+    ``sub`` addresses a sub-range of the [in, out] weight (fused splits); for
+    biases the same range applies on their only axis.
+    """
+    wnames = [f"{name_fn(i)}.weight" for i in range(n_layers)]
+    w = ckpt.get_stacked_array(
+        wnames, mesh, w_spec, transpose=transpose, sub=sub
+    )
+    b = None
+    if bias:
+        bnames = [f"{name_fn(i)}.bias" for i in range(n_layers)]
+        if all(n in ckpt for n in bnames):
+            bsub = (0, sub[1], sub[2]) if sub is not None else None
+            b = ckpt.get_stacked_array(bnames, mesh, b_spec, sub=bsub)
+    return LinearParams(w=w, b=b)
+
+
+def stacked_norm(
+    ckpt: CheckpointShards,
+    name_fn: Callable[[int], str],
+    n_layers: int,
+    mesh: Mesh,
+    *,
+    bias: bool = True,
+) -> NormParams:
+    scale = ckpt.get_stacked_array(
+        [f"{name_fn(i)}.weight" for i in range(n_layers)], mesh, P(None, None)
+    )
+    b = None
+    if bias:
+        bnames = [f"{name_fn(i)}.bias" for i in range(n_layers)]
+        if all(n in ckpt for n in bnames):
+            b = ckpt.get_stacked_array(bnames, mesh, P(None, None))
+    return NormParams(scale=scale, bias=b)

@@ -1,0 +1,97 @@
+"""Unified decoder configuration.
+
+One frozen config drives the shared decoder for every supported family; the
+flags cover exactly the structural axes on which the reference's two models
+(and the BASELINE extensions) differ:
+
+==================  =========  ============  =======  ========
+axis                GPT-J      GPT-BigCode   GPT-2    Llama
+==================  =========  ============  =======  ========
+attention           MHA        MQA (1 kv)    MHA      GQA
+positions           rotary     learned       learned  rotary
+rope style          interleav  —             —        half
+residual            parallel   sequential    seq.     seq.
+norm                LN         LN            LN       RMSNorm
+mlp                 fc/fc      fc/fc         fc/fc    SwiGLU
+tied head           no         yes           yes      no
+==================  =========  ============  =======  ========
+
+(Reference structure: GPT-J parallel residual ``gptj_modeling.py:295-310``;
+BigCode MQA ``gpt_bigcode_modeling.py:84-85,120-155``, two vocab-parallel
+embeddings wte+wpe ``:564-565``, tied head ``:792-797``.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class DecoderConfig:
+    model_type: str
+    vocab_size: int
+    hidden_size: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    head_dim: int
+    intermediate_size: int
+    max_position_embeddings: int
+
+    activation: str = "gelu_new"  # ACT2FN key (gptj_modeling.py:266)
+    norm: str = "layernorm"  # "layernorm" | "rmsnorm"
+    norm_eps: float = 1e-5
+    parallel_residual: bool = False  # GPT-J block form
+    mlp: str = "mlp"  # "mlp" | "swiglu"
+
+    positions: str = "learned"  # "learned" | "rotary" | "none"
+    rope_style: str = "interleaved"  # "interleaved" | "half"
+    rotary_dim: int | None = None  # partial rotary (config.rotary_dim, GPT-J)
+    rope_theta: float = 10000.0
+
+    attn_bias: bool = True
+    mlp_bias: bool = True
+    head_bias: bool = False
+    tie_word_embeddings: bool = False
+    # GPT-2/BigCode scale attention by 1/sqrt(D); GPT-J divides by
+    # sqrt(head_dim) too but computes it as `scale_attn` applied post-mask
+    # (gptj_modeling.py:153) — numerically the same scaled softmax.
+    attn_scale: float | None = None
+
+    # compute dtype for activations; params are loaded in this dtype too
+    dtype: str = "bfloat16"
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        return jnp.dtype(self.dtype)
+
+    @property
+    def q_size(self) -> int:
+        return self.n_heads * self.head_dim
+
+    @property
+    def kv_size(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+
+def act_fn(name: str):
+    """ACT2FN equivalent (reference uses HF's table, gptj_modeling.py:266)."""
+    import jax.numpy as jnp
+
+    table = {
+        "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+        "gelu_new": lambda x: jax.nn.gelu(x, approximate=True),
+        "gelu_fast": lambda x: jax.nn.gelu(x, approximate=True),
+        "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+        "silu": jax.nn.silu,
+        "swish": jax.nn.silu,
+        "relu": jax.nn.relu,
+        "tanh": jnp.tanh,
+    }
+    if name not in table:
+        raise KeyError(f"unsupported activation {name!r}")
+    return table[name]

@@ -1,0 +1,93 @@
+"""GPT-BigCode / StarCoder: MQA, learned positions, tied head.
+
+Capability parity with the reference's
+``custom_modeling/gpt_bigcode_modeling.py`` (926 LoC): multi-query attention
+with a single KV head replicated across TP shards while Q is head-sharded
+(``gpt_bigcode_modeling.py:84-85,120-155``) — here that is simply a
+replicated PartitionSpec on the K/V projections; the fused ``c_attn``
+checkpoint is split into Q and KV by sub-range sliced reads instead of
+loading the full tensor on every rank (``:122-127``). Two vocab-partitioned
+embeddings, wte and wpe (``:564-565``); sequential pre-LN residual
+(``:366-407``); tied lm_head from ``transformer.wte`` (``:792-797``); fp32
+(optionally per-layer-unscaled) softmax (``:49-72,175-178``) is subsumed by
+the always-fp32 softmax island in ``ops/attention.py``.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+from llmss_tpu.models._loading import stacked_linear, stacked_norm
+from llmss_tpu.models.common import DecoderConfig
+from llmss_tpu.models.decoder import Params, param_specs
+from llmss_tpu.ops.layers import load_norm
+from llmss_tpu.parallel.mesh import AXIS_TP
+from llmss_tpu.weights.loader import CheckpointShards
+
+
+def config_from_hf(hf, dtype: str = "bfloat16") -> DecoderConfig:
+    head_dim = hf.n_embd // hf.n_head
+    multi_query = getattr(hf, "multi_query", True)
+    return DecoderConfig(
+        model_type="gpt_bigcode",
+        vocab_size=hf.vocab_size,
+        hidden_size=hf.n_embd,
+        n_layers=hf.n_layer,
+        n_heads=hf.n_head,
+        n_kv_heads=1 if multi_query else hf.n_head,
+        head_dim=head_dim,
+        intermediate_size=hf.n_inner or 4 * hf.n_embd,
+        max_position_embeddings=hf.n_positions,
+        activation=hf.activation_function,
+        norm="layernorm",
+        norm_eps=hf.layer_norm_epsilon,
+        parallel_residual=False,
+        mlp="mlp",
+        positions="learned",
+        attn_bias=True,
+        mlp_bias=True,
+        tie_word_embeddings=True,
+        dtype=dtype,
+    )
+
+
+def load_params(
+    ckpt: CheckpointShards, cfg: DecoderConfig, mesh: Mesh
+) -> Params:
+    specs = param_specs(cfg, mesh.shape[AXIS_TP])
+    L, E = cfg.n_layers, cfg.hidden_size
+    kv = cfg.kv_size
+    h = "transformer.h"
+
+    def split_attn(key, lo, hi):
+        # c_attn is [E + 2*kv, E] in torch Linear layout; transposed it is
+        # [E, E + 2*kv] with Q at [:, :E], K at [:, E:E+kv], V at the rest
+        # (the reference splits at gpt_bigcode_modeling.py:126-127).
+        return stacked_linear(
+            ckpt, lambda i: f"{h}.{i}.attn.c_attn", L, mesh,
+            specs["blocks"][key].w, specs["blocks"][key].b,
+            transpose=True, sub=(1, lo, hi),
+        )
+
+    def lin(attr, key):
+        return stacked_linear(
+            ckpt, lambda i: f"{h}.{i}.{attr}", L, mesh,
+            specs["blocks"][key].w, specs["blocks"][key].b, transpose=True,
+        )
+
+    blocks: Params = {
+        "ln1": stacked_norm(ckpt, lambda i: f"{h}.{i}.ln_1", L, mesh),
+        "ln2": stacked_norm(ckpt, lambda i: f"{h}.{i}.ln_2", L, mesh),
+        "q": split_attn("q", 0, E),
+        "k": split_attn("k", E, E + kv),
+        "v": split_attn("v", E + kv, E + 2 * kv),
+        "o": lin("attn.c_proj", "o"),
+        "fc_in": lin("mlp.c_fc", "fc_in"),
+        "fc_out": lin("mlp.c_proj", "fc_out"),
+    }
+    return {
+        "wte": ckpt.get_array("transformer.wte.weight", mesh, specs["wte"]),
+        "wpe": ckpt.get_array("transformer.wpe.weight", mesh, specs["wpe"]),
+        "blocks": blocks,
+        "ln_f": load_norm(ckpt, "transformer.ln_f", mesh),
+    }

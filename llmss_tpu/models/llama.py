@@ -1,0 +1,94 @@
+"""Llama family: GQA, rotary (half style), RMSNorm, SwiGLU.
+
+Not in the reference's registry; required by the BASELINE.md north-star
+configs (Llama-2-7B TP=8). Covers Llama 1/2/3-style checkpoints (GQA via
+``num_key_value_heads``; ``rope_theta``; optional tied embeddings for the
+small Llama-3.2 variants).
+"""
+
+from __future__ import annotations
+
+from jax.sharding import Mesh
+
+from llmss_tpu.models._loading import stacked_linear, stacked_norm
+from llmss_tpu.models.common import DecoderConfig
+from llmss_tpu.models.decoder import Params, param_specs
+from llmss_tpu.ops.layers import NormParams, load_lm_head
+from llmss_tpu.parallel.mesh import AXIS_TP
+from llmss_tpu.weights.loader import CheckpointShards
+
+
+def config_from_hf(hf, dtype: str = "bfloat16") -> DecoderConfig:
+    n_heads = hf.num_attention_heads
+    head_dim = getattr(hf, "head_dim", None) or hf.hidden_size // n_heads
+    return DecoderConfig(
+        model_type="llama",
+        vocab_size=hf.vocab_size,
+        hidden_size=hf.hidden_size,
+        n_layers=hf.num_hidden_layers,
+        n_heads=n_heads,
+        n_kv_heads=getattr(hf, "num_key_value_heads", None) or n_heads,
+        head_dim=head_dim,
+        intermediate_size=hf.intermediate_size,
+        max_position_embeddings=hf.max_position_embeddings,
+        activation=hf.hidden_act,
+        norm="rmsnorm",
+        norm_eps=hf.rms_norm_eps,
+        parallel_residual=False,
+        mlp="swiglu",
+        positions="rotary",
+        rope_style="half",
+        rotary_dim=head_dim,
+        rope_theta=getattr(hf, "rope_theta", 10000.0),
+        attn_bias=False,
+        mlp_bias=False,
+        tie_word_embeddings=getattr(hf, "tie_word_embeddings", False),
+        dtype=dtype,
+    )
+
+
+def load_params(
+    ckpt: CheckpointShards, cfg: DecoderConfig, mesh: Mesh
+) -> Params:
+    specs = param_specs(cfg, mesh.shape[AXIS_TP])
+    L = cfg.n_layers
+    layers = "model.layers"
+
+    def lin(attr, key):
+        return stacked_linear(
+            ckpt, lambda i: f"{layers}.{i}.{attr}", L, mesh,
+            specs["blocks"][key].w, None, transpose=True, bias=False,
+        )
+
+    blocks: Params = {
+        "ln1": stacked_norm(
+            ckpt, lambda i: f"{layers}.{i}.input_layernorm", L, mesh,
+            bias=False,
+        ),
+        "ln2": stacked_norm(
+            ckpt, lambda i: f"{layers}.{i}.post_attention_layernorm", L, mesh,
+            bias=False,
+        ),
+        "q": lin("self_attn.q_proj", "q"),
+        "k": lin("self_attn.k_proj", "k"),
+        "v": lin("self_attn.v_proj", "v"),
+        "o": lin("self_attn.o_proj", "o"),
+        "gate": lin("mlp.gate_proj", "gate"),
+        "up": lin("mlp.up_proj", "up"),
+        "down": lin("mlp.down_proj", "down"),
+    }
+    params: Params = {
+        "wte": ckpt.get_array(
+            "model.embed_tokens.weight", mesh, specs["wte"]
+        ),
+        "blocks": blocks,
+        "ln_f": NormParams(
+            scale=ckpt.get_array("model.norm.weight", mesh, specs["ln_f"].scale),
+            bias=None,
+        ),
+    }
+    if not cfg.tie_word_embeddings:
+        params["head"] = load_lm_head(
+            ckpt, "lm_head.weight", mesh, transpose=True, bias=False
+        )
+    return params

@@ -1,0 +1,72 @@
+"""Rotary position embeddings, both conventions, computed on the fly.
+
+Replaces the reference's host-precomputed sinusoid table + gather
+(``gptj_modeling.py:26-47`` ``create_sinusoidal_positions`` /
+``rotate_every_two`` / ``apply_rotary_pos_emb``, gathered per position at
+``:206-208``): on TPU the sin/cos are cheap VPU math over the position vector
+inside the jitted step, so there is no table to store, gather, or keep in sync
+with cache length.
+
+Two layouts:
+
+- ``"interleaved"`` (GPT-J): feature pairs are (0,1), (2,3), … — the
+  reference's ``rotate_every_two`` with repeat-interleaved sin/cos
+  (``gptj_modeling.py:37-47``). Supports partial rotary via ``rotary_dim``
+  (``config.rotary_dim``, applied at ``gptj_modeling.py:210-224``).
+- ``"half"`` (GPT-NeoX / Llama): features split in halves, second half
+  negated-swapped. Used by the Llama family.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _sin_cos(positions: jax.Array, dim: int, theta: float):
+    """sin/cos [B, S, dim/2] in fp32 for integer positions."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim)
+    )
+    angles = positions[..., None].astype(jnp.float32) * inv_freq
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(
+    x: jax.Array,  # [B, S, H, D]
+    positions: jax.Array,  # [B, S] int
+    *,
+    rotary_dim: int | None = None,
+    theta: float = 10000.0,
+    style: str = "interleaved",
+) -> jax.Array:
+    """Rotate the first ``rotary_dim`` features of each head by position."""
+    D = x.shape[-1]
+    rotary_dim = rotary_dim or D
+    rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+    sin, cos = _sin_cos(positions, rotary_dim, theta)
+    sin = sin[:, :, None, :]  # broadcast over heads
+    cos = cos[:, :, None, :]
+    rotf = rot.astype(jnp.float32)
+
+    if style == "interleaved":
+        x1 = rotf[..., ::2]
+        x2 = rotf[..., 1::2]
+        r1 = x1 * cos - x2 * sin
+        r2 = x2 * cos + x1 * sin
+        rotated = jnp.stack([r1, r2], axis=-1).reshape(rotf.shape)
+    elif style == "half":
+        half = rotary_dim // 2
+        # duplicated-frequency layout: angle i applies to features i, i+half
+        x1 = rotf[..., :half]
+        x2 = rotf[..., half:]
+        r1 = x1 * cos - x2 * sin
+        r2 = x2 * cos + x1 * sin
+        rotated = jnp.concatenate([r1, r2], axis=-1)
+    else:
+        raise ValueError(f"unknown rope style {style!r}")
+
+    rotated = rotated.astype(x.dtype)
+    if rest.shape[-1] == 0:
+        return rotated
+    return jnp.concatenate([rotated, rest], axis=-1)

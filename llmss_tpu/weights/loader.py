@@ -112,7 +112,11 @@ class CheckpointShards:
         return self._cast(x)
 
     def read_slice(
-        self, name: str, index: tuple[slice, ...], transpose: bool = False
+        self,
+        name: str,
+        index: tuple[slice, ...],
+        transpose: bool = False,
+        sub: tuple[int, int, int] | None = None,
     ) -> np.ndarray:
         """Read only ``index`` bytes of tensor ``name``.
 
@@ -120,7 +124,20 @@ class CheckpointShards:
         ``index`` addresses the transposed view, and only the corresponding
         source bytes are read. This converts torch ``nn.Linear`` checkpoints
         ([out, in]) to the x@W layout ([in, out]) without a full-tensor read.
+
+        ``sub=(axis, start, stop)`` addresses a sub-range of the (possibly
+        transposed) tensor — used to split fused checkpoint tensors such as
+        GPT-BigCode's ``c_attn`` into Q and KV parts with sliced reads
+        (the reference loads the *full* fused tensor on every rank and slices
+        in memory, ``gpt_bigcode_modeling.py:120-155``; here only the
+        addressed bytes are read).
         """
+        if sub is not None:
+            axis, start, _stop = sub
+            ix = list(index)
+            s = ix[axis]
+            ix[axis] = slice((s.start or 0) + start, s.stop + start if s.stop is not None else _stop)
+            index = tuple(ix)
         sl = self._handle(name).get_slice(self._resolve(name))
         if transpose:
             index = tuple(reversed(index))
@@ -132,12 +149,28 @@ class CheckpointShards:
 
     # -- device loads -------------------------------------------------------
 
+    def _logical_shape(
+        self, name: str, transpose: bool, sub: tuple[int, int, int] | None
+    ) -> tuple[int, ...]:
+        shape = self.get_shape(name)
+        if transpose:
+            if len(shape) != 2:
+                raise ValueError("transpose load requires a 2D tensor")
+            shape = tuple(reversed(shape))
+        if sub is not None:
+            axis, start, stop = sub
+            shape = tuple(
+                (stop - start) if d == axis else n for d, n in enumerate(shape)
+            )
+        return shape
+
     def get_array(
         self,
         name: str,
         mesh: Mesh,
         spec: P = P(),
         transpose: bool = False,
+        sub: tuple[int, int, int] | None = None,
     ) -> jax.Array:
         """Load ``name`` as a global array sharded by ``spec`` over ``mesh``.
 
@@ -145,17 +178,49 @@ class CheckpointShards:
         (≙ ``get_partial_sharded``, ``weights.py:72-95``, generalized to any
         PartitionSpec).
         """
-        shape = self.get_shape(name)
-        if transpose:
-            if len(shape) != 2:
-                raise ValueError("transpose load requires a 2D tensor")
-            shape = tuple(reversed(shape))
+        shape = self._logical_shape(name, transpose, sub)
         sharding = NamedSharding(mesh, spec)
         return jax.make_array_from_callback(
             shape,
             sharding,
-            lambda index: self.read_slice(name, index, transpose=transpose),
+            lambda index: self.read_slice(
+                name, index, transpose=transpose, sub=sub
+            ),
         )
+
+    def get_stacked_array(
+        self,
+        names: Sequence[str],
+        mesh: Mesh,
+        spec: P = P(),
+        *,
+        transpose: bool = False,
+        sub: tuple[int, int, int] | None = None,
+    ) -> jax.Array:
+        """Load per-layer tensors stacked on a new leading axis.
+
+        Produces the ``[n_layers, ...]`` stacked parameters that let the model
+        run its decoder blocks under ``lax.scan`` (one compiled block instead
+        of ``n_layers`` unrolled copies). ``spec`` must include the leading
+        layer axis (normally unsharded).
+        """
+        shape = self._logical_shape(names[0], transpose, sub)
+        global_shape = (len(names), *shape)
+        sharding = NamedSharding(mesh, spec)
+
+        def callback(index: tuple[slice, ...]) -> np.ndarray:
+            l_sl = index[0]
+            lo = l_sl.start or 0
+            hi = l_sl.stop if l_sl.stop is not None else len(names)
+            parts = [
+                self.read_slice(
+                    names[l], tuple(index[1:]), transpose=transpose, sub=sub
+                )
+                for l in range(lo, hi)
+            ]
+            return np.stack(parts, axis=0)
+
+        return jax.make_array_from_callback(global_shape, sharding, callback)
 
     def get_concat_array(
         self,
