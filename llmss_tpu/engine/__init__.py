@@ -11,5 +11,6 @@ host↔device round trips beyond fetching the emitted token.
 """
 
 from llmss_tpu.engine.cache import KVCache
+from llmss_tpu.engine.engine import DecodeEngine, GenerationParams
 
-__all__ = ["KVCache"]
+__all__ = ["DecodeEngine", "GenerationParams", "KVCache"]

@@ -37,11 +37,16 @@ class KVCache(NamedTuple):
         return self.k.shape[2]
 
 
-def cache_specs(n_kv_heads: int, tp: int) -> KVCache:
-    """PartitionSpecs for the cache pytree."""
+def cache_specs(n_kv_heads: int, tp: int, *, batch_dp: bool = True) -> KVCache:
+    """PartitionSpecs for the cache pytree.
+
+    ``batch_dp=False`` replicates the batch dim (needed when the live batch
+    is smaller than the dp axis).
+    """
     head_axis = AXIS_TP if n_kv_heads % tp == 0 else None
-    kv = P(None, AXIS_DP, None, head_axis, None)
-    return KVCache(k=kv, v=kv, positions=P(AXIS_DP, None))
+    dp_axis = AXIS_DP if batch_dp else None
+    kv = P(None, dp_axis, None, head_axis, None)
+    return KVCache(k=kv, v=kv, positions=P(dp_axis, None))
 
 
 def init_cache(
@@ -54,7 +59,11 @@ def init_cache(
     head_dim: int,
     dtype=jnp.bfloat16,
 ) -> KVCache:
-    specs = cache_specs(n_kv_heads, mesh.shape[AXIS_TP])
+    specs = cache_specs(
+        n_kv_heads,
+        mesh.shape[AXIS_TP],
+        batch_dp=batch % mesh.shape[AXIS_DP] == 0,
+    )
     shape = (n_layers, batch, max_len, n_kv_heads, head_dim)
 
     def zeros(spec, shape, dtype):

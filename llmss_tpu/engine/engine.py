@@ -1,0 +1,274 @@
+"""DecodeEngine: jitted prefill + decode steps and generation loops.
+
+Replaces the reference's three decode loops (``generate.py:99-190`` cache and
+no-cache paths, ``consumer_server.py:123-166``). Differences by design:
+
+- **On-device sampling inside the jitted step**: the per-token chain
+  logits→host→rank-0 sample→NCCL broadcast (``generate.py:109-144``) becomes
+  a fused argmax/top-k/top-p/categorical on device; the host only reads the
+  emitted token (streaming mode) or nothing at all (fused mode).
+- **Two generation modes**: ``generate`` — a host-side loop around the jitted
+  decode step (streaming, early-exit on EOS); ``generate_fused`` — the whole
+  token loop as ``lax.scan`` inside one jit (zero host round-trips, the
+  throughput path).
+- **Static shapes with prompt bucketing**: prompts right-pad to a bucket
+  length (compile-once-per-bucket), pads masked out of attention — fixing the
+  reference's unmasked left-pad quirk (SURVEY.md §2.11.3).
+- **Sliding-window overflow** (`generate.py:132-142`) is ring-buffer slot
+  arithmetic (``slot = position % max_len``), not host-side trimming.
+- **Donated cache buffers**: each step consumes and re-emits the cache with
+  no reallocation (the reference re-allocates and calls
+  ``torch.cuda.empty_cache()``, ``generate.py:187``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmss_tpu.engine.cache import KVCache, init_cache
+from llmss_tpu.models.common import DecoderConfig
+from llmss_tpu.models.decoder import Params, forward
+from llmss_tpu.ops.sampling import sample
+
+
+@dataclasses.dataclass
+class GenerationParams:
+    """Per-call generation controls (≙ reference CLI flags,
+    ``generate.py:21-32``; correctness fixes per SURVEY.md §2.11.1)."""
+
+    max_new_tokens: int = 20
+    is_greedy: bool = True
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_token_id: int | None = None
+    seed: int = 0
+
+    def validate(self) -> None:
+        # Range asserts, parity with generate.py:37-40.
+        if not self.is_greedy:
+            assert self.temperature > 0.0, "temperature must be > 0"
+            assert self.top_k >= 0, "top_k must be >= 0"
+            assert 0.0 < self.top_p <= 1.0, "top_p must be in (0, 1]"
+        assert self.max_new_tokens > 0
+
+
+def _bucket(n: int, cap: int) -> int:
+    b = 16
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class DecodeEngine:
+    """Drives one model on one mesh with a fixed (batch, max_seq) envelope."""
+
+    def __init__(
+        self,
+        cfg: DecoderConfig,
+        params: Params,
+        mesh,
+        *,
+        batch_size: int = 1,
+        max_seq_len: int | None = None,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.mesh = mesh
+        self.batch_size = batch_size
+        self.max_seq_len = max_seq_len or cfg.max_position_embeddings
+        self._cache_dtype = cfg.compute_dtype
+
+        self._prefill = jax.jit(
+            partial(self._prefill_impl, cfg), donate_argnums=(2,),
+        )
+        self._decode = jax.jit(
+            partial(self._decode_impl, cfg), donate_argnums=(2,),
+        )
+        self._decode_many = jax.jit(
+            partial(self._decode_many_impl, cfg),
+            donate_argnums=(2,),
+            static_argnames=("n_steps",),
+        )
+
+    # -- jitted bodies ------------------------------------------------------
+
+    @staticmethod
+    def _prefill_impl(cfg, params, ids, cache, prompt_lens, sample_args, key):
+        B, S = ids.shape
+        positions = jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.int32), (B, S)
+        )
+        valid = positions < prompt_lens[:, None]
+        slots = positions % cache.max_len
+        kv_pos = jnp.where(valid, positions, -1)
+        logits, cache = forward(
+            cfg, params, ids, positions, cache, slots,
+            gather_idx=prompt_lens - 1, kv_write_positions=kv_pos,
+        )
+        key, sub = jax.random.split(key)
+        tok = sample(logits[:, 0], sub, **sample_args)
+        return tok, logits[:, 0], cache, key
+
+    @staticmethod
+    def _decode_impl(cfg, params, tokens, cache, cur_pos, sample_args, key):
+        # tokens [B], cur_pos [B] — position at which each token sits.
+        positions = cur_pos[:, None]
+        slots = positions % cache.max_len
+        logits, cache = forward(
+            cfg, params, tokens[:, None], positions, cache, slots,
+            last_only=True,
+        )
+        key, sub = jax.random.split(key)
+        tok = sample(logits[:, 0], sub, **sample_args)
+        return tok, logits[:, 0], cache, key
+
+    @staticmethod
+    def _decode_many_impl(
+        cfg, params, tokens, cache, cur_pos, sample_args, key, done, eos,
+        *, n_steps: int,
+    ):
+        """Fused multi-token decode: lax.scan over the single-token step."""
+
+        def body(carry, _):
+            tokens, cache, cur_pos, key, done = carry
+            positions = cur_pos[:, None]
+            slots = positions % cache.max_len
+            logits, cache = forward(
+                cfg, params, tokens[:, None], positions, cache, slots,
+                last_only=True,
+            )
+            key, sub = jax.random.split(key)
+            tok = sample(logits[:, 0], sub, **sample_args)
+            tok = jnp.where(done, eos, tok)
+            done = done | (tok == eos)
+            cur_pos = cur_pos + 1
+            return (tok, cache, cur_pos, key, done), tok
+
+        carry, toks = jax.lax.scan(
+            body, (tokens, cache, cur_pos, key, done), None, length=n_steps
+        )
+        tokens, cache, cur_pos, key, done = carry
+        return toks.T, cache, cur_pos, key, done  # toks [B, n_steps]
+
+    # -- host API -----------------------------------------------------------
+
+    def new_cache(self, batch: int | None = None) -> KVCache:
+        return init_cache(
+            self.mesh,
+            n_layers=self.cfg.n_layers,
+            batch=batch or self.batch_size,
+            max_len=self.max_seq_len,
+            n_kv_heads=self.cfg.n_kv_heads,
+            head_dim=self.cfg.head_dim,
+            dtype=self._cache_dtype,
+        )
+
+    def _sample_args(self, gen: GenerationParams, batch: int):
+        return dict(
+            temperature=jnp.full(batch, gen.temperature, jnp.float32),
+            top_k=jnp.full(batch, gen.top_k, jnp.int32),
+            top_p=jnp.full(batch, gen.top_p, jnp.float32),
+            greedy=jnp.full(batch, gen.is_greedy, bool),
+        )
+
+    def _pad_prompts(
+        self, prompts: list[list[int]], pad_id: int = 0
+    ) -> tuple[np.ndarray, np.ndarray]:
+        lens = np.array([len(p) for p in prompts], np.int32)
+        if lens.max() > self.max_seq_len:
+            raise ValueError(
+                f"prompt length {lens.max()} exceeds max_seq_len "
+                f"{self.max_seq_len}"
+            )
+        S = _bucket(int(lens.max()), self.max_seq_len)
+        ids = np.full((len(prompts), S), pad_id, np.int32)
+        for i, p in enumerate(prompts):
+            ids[i, : len(p)] = p
+        return ids, lens
+
+    def generate(
+        self,
+        prompts: list[list[int]],
+        gen: GenerationParams,
+        *,
+        on_token=None,
+    ) -> list[list[int]]:
+        """Streaming host-loop generation (≙ generate.py:99-145 cache path).
+
+        ``on_token(step, tokens: np.ndarray)`` is called per step — the
+        serving layer streams from here. Stops early when every row hit EOS.
+        """
+        gen.validate()
+        B = len(prompts)
+        ids, lens = self._pad_prompts(prompts)
+        cache = self.new_cache(B)
+        sample_args = self._sample_args(gen, B)
+        key = jax.random.key(gen.seed)
+
+        tok, _, cache, key = self._prefill(
+            self.params, jnp.asarray(ids), cache, jnp.asarray(lens),
+            sample_args, key,
+        )
+        eos = gen.eos_token_id if gen.eos_token_id is not None else -1
+        out = [[] for _ in range(B)]
+        done = np.zeros(B, bool)
+        cur_pos = jnp.asarray(lens)
+
+        for step in range(gen.max_new_tokens):
+            tok_np = np.asarray(tok)
+            newly_done = tok_np == eos
+            for i in range(B):
+                if not done[i] and not newly_done[i]:
+                    out[i].append(int(tok_np[i]))
+            done |= newly_done
+            if on_token is not None:
+                on_token(step, tok_np)
+            if done.all() or step == gen.max_new_tokens - 1:
+                break
+            tok, _, cache, key = self._decode(
+                self.params, tok, cache, cur_pos, sample_args, key
+            )
+            cur_pos = cur_pos + 1
+        return out
+
+    def generate_fused(
+        self, prompts: list[list[int]], gen: GenerationParams
+    ) -> list[list[int]]:
+        """Whole-generation-on-device path: prefill + one fused scan jit.
+
+        Zero per-token host round-trips — the TPU-native answer to the
+        reference's per-token broadcast tax (``generate.py:144``).
+        """
+        gen.validate()
+        B = len(prompts)
+        ids, lens = self._pad_prompts(prompts)
+        cache = self.new_cache(B)
+        sample_args = self._sample_args(gen, B)
+        key = jax.random.key(gen.seed)
+
+        tok, _, cache, key = self._prefill(
+            self.params, jnp.asarray(ids), cache, jnp.asarray(lens),
+            sample_args, key,
+        )
+        eos = jnp.int32(
+            gen.eos_token_id if gen.eos_token_id is not None else -1
+        )
+        done = tok == eos
+        toks, cache, _, _, done = self._decode_many(
+            self.params, tok, cache, jnp.asarray(lens), sample_args, key,
+            done, eos, n_steps=gen.max_new_tokens - 1,
+        )
+        first = np.asarray(tok)[:, None]
+        rest = np.asarray(toks)
+        all_toks = np.concatenate([first, rest], axis=1)
+        out = []
+        for row in all_toks:
+            stop = np.where(row == int(eos))[0]
+            out.append(row[: stop[0]].tolist() if stop.size else row.tolist())
+        return out

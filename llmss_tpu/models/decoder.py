@@ -260,12 +260,19 @@ def forward(
     slots: jax.Array,  # [B, S] ring slots for the new tokens
     *,
     last_only: bool = False,
+    gather_idx: jax.Array | None = None,  # [B] per-row index into S
+    kv_write_positions: jax.Array | None = None,  # [B, S]; -1 marks padding
 ) -> tuple[jax.Array, KVCache]:
     """Run the decoder; returns (logits fp32, updated cache).
 
     ``last_only=True`` projects only each row's final hidden state through the
     vocab head — the decode-loop path (the reference computes full-sequence
     logits every step and indexes [-1], ``generate.py:106-108``).
+    ``gather_idx`` generalizes this to a per-row dynamic index (right-padded
+    prefill: each row's last real token). ``kv_write_positions`` lets padding
+    slots be recorded as −1 (invalid) so later steps never attend them —
+    unlike the reference, whose pads participate in attention unmasked
+    (``generate.py:104,150`` — SURVEY.md §2.11.3, a quirk fixed here).
     """
     dtype = cfg.compute_dtype
 
@@ -277,7 +284,9 @@ def forward(
         h = h + embedding(positions, params["wpe"].astype(dtype), one_hot=True)
     h = constrain(h, P(AXIS_DP, None, None))
 
-    new_kv_positions = write_positions(cache.positions, positions, slots)
+    if kv_write_positions is None:
+        kv_write_positions = positions
+    new_kv_positions = write_positions(cache.positions, kv_write_positions, slots)
     kv_valid = new_kv_positions >= 0
     mask = make_causal_mask(positions, new_kv_positions, kv_valid)
 
@@ -293,7 +302,10 @@ def forward(
     )
 
     h = _norm(cfg, h, params["ln_f"])
-    if last_only:
+    if gather_idx is not None:
+        B = h.shape[0]
+        h = h[jnp.arange(B), gather_idx][:, None, :]
+    elif last_only:
         h = h[:, -1:, :]
 
     if cfg.tie_word_embeddings:
