@@ -1,0 +1,128 @@
+"""Benchmark: decode throughput (tokens/sec/chip) on the flagship model.
+
+Run on real TPU hardware by the driver. Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
+reported against the **HBM-bandwidth roofline** for batched decode on this
+chip: a decode step must stream all parameter bytes plus the live KV-cache
+bytes from HBM, so
+
+    roofline_tokens_per_sec = batch * BW / (param_bytes + batch * kv_bytes)
+
+``vs_baseline`` = measured / roofline — i.e. the fraction of the chip's
+theoretical decode ceiling this framework reaches (1.0 is perfect).
+
+Model: Llama-architecture ~1.2B (the BASELINE.md config-ladder scale that
+fits one v5e chip with headroom), random-init bf16, batch 8, 128-token
+prefill, fused 128-token decode.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BATCH = int(os.environ.get("BENCH_BATCH", 8))
+PROMPT = int(os.environ.get("BENCH_PROMPT", 128))
+DECODE = int(os.environ.get("BENCH_DECODE", 128))
+HBM_GBPS = float(os.environ.get("BENCH_HBM_GBPS", 819.0))  # v5e
+
+
+def flagship_cfg():
+    from llmss_tpu.models.common import DecoderConfig
+
+    return DecoderConfig(
+        model_type="llama",
+        vocab_size=32000,
+        hidden_size=2048,
+        n_layers=20,
+        n_heads=16,
+        n_kv_heads=16,
+        head_dim=128,
+        intermediate_size=5504,
+        max_position_embeddings=2048,
+        activation="silu",
+        norm="rmsnorm",
+        norm_eps=1e-5,
+        mlp="swiglu",
+        positions="rotary",
+        rope_style="half",
+        rotary_dim=128,
+        attn_bias=False,
+        mlp_bias=False,
+        tie_word_embeddings=False,
+        dtype="bfloat16",
+    )
+
+
+def main():
+    from llmss_tpu.engine import DecodeEngine, GenerationParams
+    from llmss_tpu.models.decoder import init_params
+    from llmss_tpu.parallel import MeshPlan, make_mesh
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshPlan(tp=n_dev))
+    cfg = flagship_cfg()
+    params = init_params(cfg, mesh, jax.random.key(0))
+    n_params = sum(
+        np.prod(x.shape) for x in jax.tree.leaves(params)
+    )
+    param_bytes = float(n_params) * 2  # bf16
+
+    max_seq = PROMPT + DECODE
+    engine = DecodeEngine(cfg, params, mesh, max_seq_len=max_seq)
+    gen_warm = GenerationParams(max_new_tokens=8, is_greedy=True)
+    gen = GenerationParams(max_new_tokens=DECODE, is_greedy=True)
+
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(0, cfg.vocab_size, PROMPT).tolist() for _ in range(BATCH)
+    ]
+
+    # Warmup (compile prefill + decode_many for both step counts).
+    engine.generate_fused(prompts, gen_warm)
+    engine.generate_fused(prompts, gen)
+
+    # TTFT: prefill + first sampled token, compiled.
+    cache = engine.new_cache(BATCH)
+    ids, lens = engine._pad_prompts(prompts)
+    sa = engine._sample_args(gen, BATCH)
+    t0 = time.perf_counter()
+    tok, _, cache, _ = engine._prefill(
+        engine.params, jnp.asarray(ids), cache, jnp.asarray(lens), sa,
+        jax.random.key(1),
+    )
+    tok.block_until_ready()
+    ttft_ms = (time.perf_counter() - t0) * 1e3
+    del cache
+
+    # Decode throughput: fused generation, steady state.
+    t0 = time.perf_counter()
+    out = engine.generate_fused(prompts, gen)
+    dt = time.perf_counter() - t0
+    n_tokens = sum(len(o) for o in out)
+    tok_per_sec_per_chip = n_tokens / dt / n_dev
+
+    kv_bytes_per_token = (
+        2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * 2 * max_seq / 2
+    )  # avg half-full cache, k+v, bf16
+    roofline = BATCH * HBM_GBPS * 1e9 / (
+        param_bytes + BATCH * kv_bytes_per_token
+    )
+    result = {
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec_per_chip, 1),
+        "unit": f"tok/s/chip (1.2B bf16, batch={BATCH}, ttft_ms={ttft_ms:.0f})",
+        "vs_baseline": round(tok_per_sec_per_chip / roofline, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

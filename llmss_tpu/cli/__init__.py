@@ -1,0 +1,1 @@
+"""Command-line entry points (≙ reference repo-root ``generate.py``)."""
