@@ -169,12 +169,17 @@ class DecodeEngine:
             dtype=self._cache_dtype,
         )
 
-    def _sample_args(self, gen: GenerationParams, batch: int):
+    def _sample_args(self, gens: "GenerationParams | list[GenerationParams]",
+                     batch: int):
+        if isinstance(gens, GenerationParams):
+            gens = [gens] * batch
         return dict(
-            temperature=jnp.full(batch, gen.temperature, jnp.float32),
-            top_k=jnp.full(batch, gen.top_k, jnp.int32),
-            top_p=jnp.full(batch, gen.top_p, jnp.float32),
-            greedy=jnp.full(batch, gen.is_greedy, bool),
+            temperature=jnp.asarray(
+                [g.temperature for g in gens], jnp.float32
+            ),
+            top_k=jnp.asarray([g.top_k for g in gens], jnp.int32),
+            top_p=jnp.asarray([g.top_p for g in gens], jnp.float32),
+            greedy=jnp.asarray([g.is_greedy for g in gens], bool),
         )
 
     def _pad_prompts(
@@ -195,41 +200,54 @@ class DecodeEngine:
     def generate(
         self,
         prompts: list[list[int]],
-        gen: GenerationParams,
+        gen: GenerationParams | list[GenerationParams],
         *,
         on_token=None,
     ) -> list[list[int]]:
         """Streaming host-loop generation (≙ generate.py:99-145 cache path).
 
+        ``gen`` may be a list with one entry per prompt: a batch can mix
+        greedy/sampled requests with different warpers, lengths, and EOS ids
+        (the serving path; the reference hard-codes one config per batch).
         ``on_token(step, tokens: np.ndarray)`` is called per step — the
-        serving layer streams from here. Stops early when every row hit EOS.
+        serving layer streams from here. Stops early when every row is done.
         """
-        gen.validate()
         B = len(prompts)
+        gens = gen if isinstance(gen, list) else [gen] * B
+        assert len(gens) == B
+        for g in gens:
+            g.validate()
         ids, lens = self._pad_prompts(prompts)
         cache = self.new_cache(B)
-        sample_args = self._sample_args(gen, B)
-        key = jax.random.key(gen.seed)
+        sample_args = self._sample_args(gens, B)
+        key = jax.random.key(gens[0].seed)
 
         tok, _, cache, key = self._prefill(
             self.params, jnp.asarray(ids), cache, jnp.asarray(lens),
             sample_args, key,
         )
-        eos = gen.eos_token_id if gen.eos_token_id is not None else -1
+        eos = np.asarray(
+            [g.eos_token_id if g.eos_token_id is not None else -1
+             for g in gens]
+        )
+        max_new = np.asarray([g.max_new_tokens for g in gens])
         out = [[] for _ in range(B)]
         done = np.zeros(B, bool)
         cur_pos = jnp.asarray(lens)
+        total_steps = int(max_new.max())
 
-        for step in range(gen.max_new_tokens):
+        for step in range(total_steps):
             tok_np = np.asarray(tok)
-            newly_done = tok_np == eos
+            newly_done = (tok_np == eos) | (step >= max_new)
             for i in range(B):
                 if not done[i] and not newly_done[i]:
                     out[i].append(int(tok_np[i]))
+                    if len(out[i]) == max_new[i]:
+                        done[i] = True
             done |= newly_done
             if on_token is not None:
                 on_token(step, tok_np)
-            if done.all() or step == gen.max_new_tokens - 1:
+            if done.all() or step == total_steps - 1:
                 break
             tok, _, cache, key = self._decode(
                 self.params, tok, cache, cur_pos, sample_args, key

@@ -1,0 +1,35 @@
+"""Serving stack: producer (HTTP frontend) / broker / consumer (model worker).
+
+TPU-native replacement for the reference's ``poc-server/producer-consumer``:
+same three-role architecture and wire schema, with the reference's known
+defects fixed (SURVEY.md §2.10):
+
+- **Request-id correlation**: the reference's producer busy-polls a shared
+  response queue and can deliver responses to the wrong waiter
+  (``producer_server.py:47-54``); every request here carries a UUID and
+  responses are routed by it.
+- **Batching**: the reference hard-codes ``batch_size = 1``
+  (``consumer_server.py:73``); the worker batches up to ``batch_size``
+  requests per engine call, and the continuous-batching scheduler
+  (``scheduler.py``) admits requests into a running batch at token
+  granularity.
+- **No per-token broadcast**: the consumer is a single controller driving the
+  jitted engine; the reference's ``broadcast_object_list`` request fan-out and
+  per-token token broadcast (``consumer_server.py:108,165``) have no
+  equivalent — there are no worker ranks to synchronize.
+
+Broker backends: ``InProcBroker`` (stdlib queues — testing and single-process
+serving) and ``RedisBroker`` (wire-compatible with the reference's Redis
+list queues ``pqueue``/``squeue``; requires the optional ``redis`` package).
+"""
+
+from llmss_tpu.serve.broker import Broker, InProcBroker, RedisBroker
+from llmss_tpu.serve.protocol import GenerateRequest, GenerateResponse
+
+__all__ = [
+    "Broker",
+    "GenerateRequest",
+    "GenerateResponse",
+    "InProcBroker",
+    "RedisBroker",
+]

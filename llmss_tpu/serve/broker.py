@@ -1,0 +1,111 @@
+"""Brokers: request queue + id-correlated response delivery.
+
+The reference's broker is a pair of Redis lists — requests ``lpush``-ed onto
+``pqueue`` (``producer_server.py:47-48``), responses onto ``squeue``
+(``consumer_server.py:173``) — with the producer busy-polling ``squeue`` and
+taking *any* response (``producer_server.py:50-54``), which mis-delivers under
+concurrency. Both brokers here keep the queue shape but deliver responses by
+request id.
+"""
+
+from __future__ import annotations
+
+import abc
+import queue
+import threading
+import time
+
+from llmss_tpu.serve.protocol import GenerateRequest, GenerateResponse
+
+
+class Broker(abc.ABC):
+    @abc.abstractmethod
+    def push_request(self, req: GenerateRequest) -> None: ...
+
+    @abc.abstractmethod
+    def pop_request(self, timeout: float = 0.0) -> GenerateRequest | None: ...
+
+    @abc.abstractmethod
+    def push_response(self, resp: GenerateResponse) -> None: ...
+
+    @abc.abstractmethod
+    def wait_response(
+        self, request_id: str, timeout: float = 60.0
+    ) -> GenerateResponse | None: ...
+
+
+class InProcBroker(Broker):
+    """stdlib-queue broker for tests and single-process serving."""
+
+    def __init__(self):
+        self._requests: queue.Queue[GenerateRequest] = queue.Queue()
+        self._responses: dict[str, GenerateResponse] = {}
+        self._cond = threading.Condition()
+
+    def push_request(self, req: GenerateRequest) -> None:
+        self._requests.put(req)
+
+    def pop_request(self, timeout: float = 0.0) -> GenerateRequest | None:
+        try:
+            return self._requests.get(timeout=timeout) if timeout else (
+                self._requests.get_nowait()
+            )
+        except queue.Empty:
+            return None
+
+    def push_response(self, resp: GenerateResponse) -> None:
+        with self._cond:
+            self._responses[resp.id] = resp
+            self._cond.notify_all()
+
+    def wait_response(
+        self, request_id: str, timeout: float = 60.0
+    ) -> GenerateResponse | None:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while request_id not in self._responses:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            return self._responses.pop(request_id)
+
+
+class RedisBroker(Broker):
+    """Wire-compatible with the reference's Redis lists, id-corrected.
+
+    Requests ride the ``pqueue`` list as JSON (same as
+    ``producer_server.py:47-48``); responses go to per-request keys
+    ``squeue:{id}`` (BLPOP-able) instead of one shared ``squeue``, fixing the
+    mis-delivery race while staying in plain Redis list primitives.
+    """
+
+    def __init__(self, host: str = "localhost", port: int = 6379,
+                 request_queue: str = "pqueue", response_prefix: str = "squeue"):
+        import redis  # gated: optional dependency
+
+        self._r = redis.Redis(host=host, port=port)
+        self._rq = request_queue
+        self._prefix = response_prefix
+
+    def push_request(self, req: GenerateRequest) -> None:
+        self._r.lpush(self._rq, req.to_json())
+
+    def pop_request(self, timeout: float = 0.0) -> GenerateRequest | None:
+        if timeout:
+            item = self._r.brpop(self._rq, timeout=timeout)
+            payload = item[1] if item else None
+        else:
+            payload = self._r.rpop(self._rq)
+        return GenerateRequest.from_json(payload) if payload else None
+
+    def push_response(self, resp: GenerateResponse) -> None:
+        key = f"{self._prefix}:{resp.id}"
+        self._r.lpush(key, resp.to_json())
+        self._r.expire(key, 600)
+
+    def wait_response(
+        self, request_id: str, timeout: float = 60.0
+    ) -> GenerateResponse | None:
+        item = self._r.brpop(f"{self._prefix}:{request_id}", timeout=timeout)
+        return GenerateResponse.from_json(item[1]) if item else None

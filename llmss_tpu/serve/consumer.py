@@ -1,0 +1,172 @@
+"""Consumer: the model worker.
+
+≙ reference ``consumer_server.py``: poll the broker, tokenize, run the
+engine, respond. Structural upgrades over the reference (SURVEY.md §2.10,
+§3.2):
+
+- **Single controller**: the reference runs one process per GPU, fans the
+  request out with ``broadcast_object_list`` (``consumer_server.py:108``) and
+  every sampled token with ``dist.broadcast`` (``:165``); here one process
+  drives the whole mesh — those collectives do not exist.
+- **Batched**: drains up to ``batch_size`` queued requests per engine call
+  (reference: ``batch_size = 1`` hard-coded, ``consumer_server.py:73``), with
+  heterogeneous per-request sampling params.
+- **Failure containment**: a failing batch produces per-request error
+  responses and the worker keeps serving (the reference crashes).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from llmss_tpu.engine import DecodeEngine, GenerationParams
+from llmss_tpu.serve.broker import Broker
+from llmss_tpu.serve.protocol import GenerateRequest, GenerateResponse
+
+logger = logging.getLogger("llmss_tpu.serve")
+
+
+class Worker:
+    def __init__(
+        self,
+        engine: DecodeEngine,
+        broker: Broker,
+        tokenizer=None,
+        batch_size: int = 8,
+        poll_timeout_s: float = 0.2,
+    ):
+        self.engine = engine
+        self.broker = broker
+        self.tokenizer = tokenizer
+        self.batch_size = batch_size
+        self.poll_timeout_s = poll_timeout_s
+
+    # -- request plumbing ---------------------------------------------------
+
+    def _encode(self, req: GenerateRequest) -> list[int]:
+        if req.token_ids is not None:
+            return list(req.token_ids)
+        if self.tokenizer is None:
+            raise ValueError("no tokenizer configured; send token_ids")
+        return self.tokenizer(req.prompt)["input_ids"]
+
+    def _gen_params(self, req: GenerateRequest) -> GenerationParams:
+        eos = None
+        if self.tokenizer is not None:
+            eos = self.tokenizer.eos_token_id
+        return GenerationParams(
+            max_new_tokens=req.max_new_tokens,
+            is_greedy=req.is_greedy,
+            temperature=req.temperature,
+            top_k=req.top_k,
+            top_p=req.top_p,
+            eos_token_id=eos,
+            seed=req.seed,
+        )
+
+    def _gather(self) -> list[GenerateRequest]:
+        """Block briefly for one request, then drain the queue up to
+        batch_size (the reference instead spins at batch_size=1,
+        consumer_server.py:75-81)."""
+        first = self.broker.pop_request(timeout=self.poll_timeout_s)
+        if first is None:
+            return []
+        batch = [first]
+        while len(batch) < self.batch_size:
+            nxt = self.broker.pop_request()
+            if nxt is None:
+                break
+            batch.append(nxt)
+        return batch
+
+    # -- serving loop -------------------------------------------------------
+
+    def run_once(self) -> int:
+        batch = self._gather()
+        if not batch:
+            return 0
+
+        prompts, gens, ok = [], [], []
+        for req in batch:
+            try:
+                req.validate()
+                prompts.append(self._encode(req))
+                gens.append(self._gen_params(req))
+                ok.append(req)
+            except Exception as e:  # noqa: BLE001 — per-request error surface
+                self.broker.push_response(
+                    GenerateResponse(id=req.id, error=str(e))
+                )
+        if not ok:
+            return len(batch)
+
+        try:
+            outs = self.engine.generate(prompts, gens)
+        except Exception as e:  # noqa: BLE001 — batch failure containment
+            logger.exception("batch failed")
+            for req in ok:
+                self.broker.push_response(
+                    GenerateResponse(id=req.id, error=f"engine error: {e}")
+                )
+            return len(batch)
+
+        for req, toks in zip(ok, outs):
+            text = (
+                self.tokenizer.decode(toks) if self.tokenizer is not None
+                else None
+            )
+            self.broker.push_response(
+                GenerateResponse(
+                    id=req.id, prompt=req.prompt, continuation=text,
+                    token_ids=toks,
+                )
+            )
+        return len(batch)
+
+    def run_forever(self, stop: threading.Event | None = None) -> None:
+        while stop is None or not stop.is_set():
+            self.run_once()
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser("llmss-consumer")
+    parser.add_argument("--pretrained_model_path", required=True)
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--max_seq_len", type=int, default=None)
+    parser.add_argument("--tp", type=int, default=None)
+    parser.add_argument("--dp", type=int, default=1)
+    parser.add_argument("--dtype", type=str, default=None)
+    parser.add_argument("--redis_host", default="localhost")
+    parser.add_argument("--redis_port", type=int, default=6379)
+    args = parser.parse_args(argv)
+
+    from transformers import AutoTokenizer
+
+    from llmss_tpu.models.registry import load_model
+    from llmss_tpu.parallel import (
+        MeshPlan, default_compute_dtype, initialize_runtime, make_mesh,
+    )
+    from llmss_tpu.serve.broker import RedisBroker
+
+    initialize_runtime()
+    mesh = make_mesh(MeshPlan(dp=args.dp, tp=args.tp))
+    dtype = args.dtype or str(default_compute_dtype())
+    cfg, params = load_model(args.pretrained_model_path, mesh, dtype=dtype)
+    engine = DecodeEngine(
+        cfg, params, mesh,
+        max_seq_len=args.max_seq_len or cfg.max_position_embeddings,
+    )
+    tokenizer = AutoTokenizer.from_pretrained(args.pretrained_model_path)
+    worker = Worker(
+        engine, RedisBroker(args.redis_host, args.redis_port), tokenizer,
+        batch_size=args.batch_size,
+    )
+    print("consumer serving")
+    worker.run_forever()
+
+
+if __name__ == "__main__":
+    main()

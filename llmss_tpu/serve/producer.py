@@ -1,0 +1,135 @@
+"""Producer: the HTTP frontend.
+
+≙ reference ``producer_server.py`` (FastAPI + uvicorn): one route,
+``POST /generate``, same JSON schema. Implemented on the stdlib threading
+HTTP server so the serving path has zero non-baked dependencies; a FastAPI
+app factory is provided for deployments that have it installed. Unlike the
+reference — which busy-polls the shared response queue and can return another
+caller's response (``producer_server.py:50-54``) — each handler waits on its
+own request id.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from llmss_tpu.serve.broker import Broker
+from llmss_tpu.serve.protocol import GenerateRequest
+
+
+class ProducerServer:
+    def __init__(self, broker: Broker, host: str = "0.0.0.0",
+                 port: int = 8000, timeout_s: float = 300.0):
+        self.broker = broker
+        self.timeout_s = timeout_s
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._reply(200, {"status": "ok"})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    self._reply(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = GenerateRequest.from_json(self.rfile.read(n))
+                    req.validate()
+                except Exception as e:  # noqa: BLE001 — client error surface
+                    self._reply(400, {"error": str(e)})
+                    return
+                outer.broker.push_request(req)
+                resp = outer.broker.wait_response(req.id, outer.timeout_s)
+                if resp is None:
+                    self._reply(504, {"error": "timed out", "id": req.id})
+                elif resp.error:
+                    self._reply(500, {"error": resp.error, "id": req.id})
+                else:
+                    self._reply(200, json.loads(resp.to_json()))
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+
+def create_fastapi_app(broker: Broker, timeout_s: float = 300.0):
+    """FastAPI variant of the producer (optional dependency, gated)."""
+    from fastapi import FastAPI, HTTPException
+
+    app = FastAPI()
+
+    @app.post("/generate")
+    def generate(payload: dict):
+        req = GenerateRequest.from_json(json.dumps(payload))
+        try:
+            req.validate()
+        except ValueError as e:
+            raise HTTPException(400, str(e)) from e
+        broker.push_request(req)
+        resp = broker.wait_response(req.id, timeout_s)
+        if resp is None:
+            raise HTTPException(504, "timed out")
+        if resp.error:
+            raise HTTPException(500, resp.error)
+        return json.loads(resp.to_json())
+
+    @app.get("/health")
+    def health():
+        return {"status": "ok"}
+
+    return app
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser("llmss-producer")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--redis_host", default="localhost")
+    parser.add_argument("--redis_port", type=int, default=6379)
+    args = parser.parse_args(argv)
+
+    from llmss_tpu.serve.broker import RedisBroker
+
+    broker = RedisBroker(args.redis_host, args.redis_port)
+    server = ProducerServer(broker, args.host, args.port)
+    print(f"producer listening on {args.host}:{server.port}")
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

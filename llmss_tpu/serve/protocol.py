@@ -1,0 +1,67 @@
+"""Wire schema for the producer/consumer stack.
+
+Superset of the reference's schema (``producer_server.py:9-21``):
+``{prompt, max_new_tokens, is_greedy, temperature, top_p, top_k}`` →
+``{prompt, continuation}`` — extended with a request ``id`` (correlation fix),
+optional raw ``token_ids`` (tokenizer-less clients and tests), and token-level
+outputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import uuid
+
+
+@dataclasses.dataclass
+class GenerateRequest:
+    prompt: str | None = None
+    token_ids: list[int] | None = None
+    max_new_tokens: int = 20
+    is_greedy: bool = True
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0
+    seed: int = 0
+    id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str | bytes) -> "GenerateRequest":
+        d = json.loads(s)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def validate(self) -> None:
+        if self.prompt is None and self.token_ids is None:
+            raise ValueError("one of prompt / token_ids is required")
+        if not self.is_greedy:
+            if self.temperature <= 0:
+                raise ValueError("temperature must be > 0")
+            if not (0.0 < self.top_p <= 1.0):
+                raise ValueError("top_p must be in (0, 1]")
+            if self.top_k < 0:
+                raise ValueError("top_k must be >= 0")
+        if self.max_new_tokens <= 0:
+            raise ValueError("max_new_tokens must be > 0")
+
+
+@dataclasses.dataclass
+class GenerateResponse:
+    id: str
+    prompt: str | None = None
+    continuation: str | None = None
+    token_ids: list[int] | None = None
+    error: str | None = None
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str | bytes) -> "GenerateResponse":
+        d = json.loads(s)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})

@@ -1,0 +1,124 @@
+"""Serving stack: HTTP round-trip, id correlation under concurrency,
+error containment."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import httpx
+
+from llmss_tpu.engine import DecodeEngine, GenerationParams
+from llmss_tpu.models import config_from_hf
+from llmss_tpu.models.registry import MODEL_REGISTRY
+from llmss_tpu.parallel import MeshPlan, make_mesh
+from llmss_tpu.serve import GenerateRequest, InProcBroker
+from llmss_tpu.serve.consumer import Worker
+from llmss_tpu.serve.producer import ProducerServer
+from llmss_tpu.weights import CheckpointShards, weight_files
+
+
+@pytest.fixture(scope="module")
+def serving(tmp_path_factory, devices):
+    import torch
+    import transformers as tr
+
+    torch.manual_seed(11)
+    cfg_hf = tr.GPT2Config(
+        vocab_size=64, n_positions=64, n_embd=32, n_layer=2, n_head=4
+    )
+    d = tmp_path_factory.mktemp("serve") / "m"
+    tr.GPT2LMHeadModel(cfg_hf).eval().save_pretrained(
+        d, safe_serialization=True
+    )
+
+    mesh = make_mesh(MeshPlan(dp=2, tp=4))
+    from transformers import AutoConfig
+
+    cfg = config_from_hf(AutoConfig.from_pretrained(d), dtype="float32")
+    ckpt = CheckpointShards(weight_files(str(d)), dtype=np.float32)
+    params = MODEL_REGISTRY["gpt2"].load_params(ckpt, cfg, mesh)
+    engine = DecodeEngine(cfg, params, mesh, max_seq_len=64)
+
+    broker = InProcBroker()
+    worker = Worker(engine, broker, batch_size=4, poll_timeout_s=0.05)
+    stop = threading.Event()
+    t = threading.Thread(target=worker.run_forever, args=(stop,), daemon=True)
+    t.start()
+
+    server = ProducerServer(broker, host="127.0.0.1", port=0, timeout_s=120)
+    server.start()
+
+    yield server, engine
+    stop.set()
+    server.stop()
+
+
+def _post(port, payload, timeout=120.0):
+    return httpx.post(
+        f"http://127.0.0.1:{port}/generate", json=payload, timeout=timeout
+    )
+
+
+def test_roundtrip(serving):
+    server, _ = serving
+    r = _post(server.port, {
+        "token_ids": [1, 2, 3], "max_new_tokens": 4, "is_greedy": True,
+    })
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert len(body["token_ids"]) == 4
+    assert body["id"]
+
+
+def test_correlation_under_concurrency(serving):
+    """Concurrent requests each get their own answer (the reference's
+    producer can mix these up — SURVEY.md §2.10)."""
+    server, engine = serving
+    prompts = [[i + 1, i + 2, i + 3] for i in range(6)]
+    expected = engine.generate(
+        prompts, [GenerationParams(max_new_tokens=4, is_greedy=True)] * 6
+    )
+
+    results = {}
+
+    def call(i):
+        r = _post(server.port, {
+            "token_ids": prompts[i], "max_new_tokens": 4, "is_greedy": True,
+        })
+        results[i] = r.json()["token_ids"]
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    for i in range(6):
+        assert results[i] == expected[i], (i, results[i], expected[i])
+
+
+def test_bad_request_and_health(serving):
+    server, _ = serving
+    r = _post(server.port, {"max_new_tokens": 4})
+    assert r.status_code == 400
+    r = _post(server.port, {
+        "token_ids": [1], "is_greedy": False, "temperature": -1.0,
+    })
+    assert r.status_code == 400
+    r = httpx.get(f"http://127.0.0.1:{server.port}/health", timeout=10)
+    assert r.status_code == 200
+
+
+def test_mixed_params_batch(serving):
+    server, _ = serving
+    greedy = _post(server.port, {
+        "token_ids": [5, 6], "max_new_tokens": 3, "is_greedy": True,
+    }).json()
+    sampled = _post(server.port, {
+        "token_ids": [5, 6], "max_new_tokens": 6, "is_greedy": False,
+        "temperature": 0.7, "top_k": 5, "top_p": 0.9, "seed": 1,
+    }).json()
+    assert len(greedy["token_ids"]) == 3
+    assert len(sampled["token_ids"]) == 6
