@@ -1,0 +1,181 @@
+"""Continuous batching: iteration-level request scheduling.
+
+The reference serves one request at a time end-to-end
+(``consumer_server.py:73`` ``batch_size = 1``, with a TODO admitting batching
+is future work). This scheduler implements Orca-style continuous batching on
+top of the static-shape engine: a persistent ``[L, B, T]`` ring cache whose
+**rows** are the scheduling unit. New requests are prefilled into a batch-1
+scratch cache and inserted into a free row between decode steps; every decode
+step advances all active rows with per-row sampling parameters; finished rows
+free immediately for the next waiting request — no request waits for an
+unrelated request to finish.
+
+Invariant tested in ``tests/test_continuous.py``: interleaved admission must
+produce exactly the tokens the request would get alone (row isolation — the
+causal mask is driven by per-row cache positions, so rows never see each
+other).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmss_tpu.engine.cache import KVCache
+from llmss_tpu.engine.engine import DecodeEngine, GenerationParams, _bucket
+
+
+@dataclasses.dataclass
+class _Row:
+    req_id: str
+    gen: GenerationParams
+    out: list[int]
+    cur_pos: int
+    done_cb: Callable[[list[int]], None]
+
+
+class ContinuousBatcher:
+    def __init__(self, engine: DecodeEngine, *, rows: int = 8):
+        self.engine = engine
+        self.rows = rows
+        self.cache = engine.new_cache(rows)
+        self._scratch_template = None
+        self.pending: deque = deque()
+        self.active: dict[int, _Row] = {}
+        self._free = list(range(rows))
+        self._tokens = np.zeros(rows, np.int32)
+        self._key = jax.random.key(0)
+        self._step_count = 0
+        self._lock = threading.Lock()
+
+        cfg = engine.cfg
+        self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
+        self._prefill_row = jax.jit(
+            partial(DecodeEngine._prefill_impl, cfg), donate_argnums=(2,),
+        )
+
+    @staticmethod
+    def _insert_impl(big: KVCache, small: KVCache, row) -> KVCache:
+        return KVCache(
+            k=big.k.at[:, row].set(small.k[:, 0]),
+            v=big.v.at[:, row].set(small.v[:, 0]),
+            positions=big.positions.at[row].set(small.positions[0]),
+        )
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(
+        self,
+        token_ids: list[int],
+        gen: GenerationParams,
+        done_cb: Callable[[list[int]], None],
+        req_id: str = "",
+    ) -> None:
+        gen.validate()
+        with self._lock:
+            self.pending.append((req_id, list(token_ids), gen, done_cb))
+
+    # -- scheduling ---------------------------------------------------------
+
+    def _admit_one(self) -> bool:
+        with self._lock:
+            if not self.pending or not self._free:
+                return False
+            req_id, ids, gen, cb = self.pending.popleft()
+            row = self._free.pop()
+
+        S = _bucket(len(ids), self.engine.max_seq_len)
+        padded = np.zeros((1, S), np.int32)
+        padded[0, : len(ids)] = ids
+        scratch = self.engine.new_cache(1)
+        sample_args = self.engine._sample_args(gen, 1)
+        self._key, sub = jax.random.split(self._key)
+        tok, _, scratch, _ = self._prefill_row(
+            self.engine.params, jnp.asarray(padded), scratch,
+            jnp.asarray([len(ids)], jnp.int32), sample_args, sub,
+        )
+        self.cache = self._insert(self.cache, scratch, jnp.int32(row))
+
+        first = int(np.asarray(tok)[0])
+        r = _Row(req_id=req_id, gen=gen, out=[], cur_pos=len(ids), done_cb=cb)
+        eos = gen.eos_token_id if gen.eos_token_id is not None else -1
+        if first == eos or gen.max_new_tokens == 0:
+            self._finish(row, r)
+            return True
+        r.out.append(first)
+        self._tokens[row] = first
+        self.active[row] = r
+        if len(r.out) >= r.gen.max_new_tokens:
+            self._finish(row, r)
+        return True
+
+    def _finish(self, row: int, r: _Row) -> None:
+        self.active.pop(row, None)
+        with self._lock:
+            self._free.append(row)
+        r.done_cb(r.out)
+
+    def _sample_args_all(self):
+        gens = []
+        for i in range(self.rows):
+            r = self.active.get(i)
+            gens.append(r.gen if r else GenerationParams())
+        return self.engine._sample_args(gens, self.rows)
+
+    def step(self) -> int:
+        """Admit waiting requests, then advance all active rows one token."""
+        while self._admit_one():
+            pass
+        if not self.active:
+            return 0
+
+        cur_pos = np.zeros(self.rows, np.int32)
+        for i, r in self.active.items():
+            cur_pos[i] = r.cur_pos
+        self._key, sub = jax.random.split(self._key)
+        tok, _, self.cache, _ = self.engine._decode(
+            self.engine.params, jnp.asarray(self._tokens), self.cache,
+            jnp.asarray(cur_pos), self._sample_args_all(), sub,
+        )
+        tok_np = np.asarray(tok)
+
+        n = 0
+        for i in list(self.active):
+            r = self.active[i]
+            t = int(tok_np[i])
+            r.cur_pos += 1
+            eos = r.gen.eos_token_id if r.gen.eos_token_id is not None else -1
+            if t == eos:
+                self._finish(i, r)
+                continue
+            r.out.append(t)
+            n += 1
+            self._tokens[i] = t
+            if len(r.out) >= r.gen.max_new_tokens:
+                self._finish(i, r)
+        self._step_count += 1
+        return n
+
+    @property
+    def idle(self) -> bool:
+        with self._lock:
+            return not self.active and not self.pending
+
+    def run_until_idle(self) -> None:
+        while not self.idle:
+            self.step()
+
+    def run_forever(self, stop: threading.Event, poll_s: float = 0.005):
+        while not stop.is_set():
+            if self.idle:
+                time.sleep(poll_s)
+                continue
+            self.step()

@@ -27,6 +27,27 @@ from llmss_tpu.serve.protocol import GenerateRequest, GenerateResponse
 logger = logging.getLogger("llmss_tpu.serve")
 
 
+def encode_request(tokenizer, req: GenerateRequest) -> list[int]:
+    if req.token_ids is not None:
+        return list(req.token_ids)
+    if tokenizer is None:
+        raise ValueError("no tokenizer configured; send token_ids")
+    return tokenizer(req.prompt)["input_ids"]
+
+
+def gen_params_from(tokenizer, req: GenerateRequest) -> GenerationParams:
+    eos = tokenizer.eos_token_id if tokenizer is not None else None
+    return GenerationParams(
+        max_new_tokens=req.max_new_tokens,
+        is_greedy=req.is_greedy,
+        temperature=req.temperature,
+        top_k=req.top_k,
+        top_p=req.top_p,
+        eos_token_id=eos,
+        seed=req.seed,
+    )
+
+
 class Worker:
     def __init__(
         self,
@@ -45,25 +66,10 @@ class Worker:
     # -- request plumbing ---------------------------------------------------
 
     def _encode(self, req: GenerateRequest) -> list[int]:
-        if req.token_ids is not None:
-            return list(req.token_ids)
-        if self.tokenizer is None:
-            raise ValueError("no tokenizer configured; send token_ids")
-        return self.tokenizer(req.prompt)["input_ids"]
+        return encode_request(self.tokenizer, req)
 
     def _gen_params(self, req: GenerateRequest) -> GenerationParams:
-        eos = None
-        if self.tokenizer is not None:
-            eos = self.tokenizer.eos_token_id
-        return GenerationParams(
-            max_new_tokens=req.max_new_tokens,
-            is_greedy=req.is_greedy,
-            temperature=req.temperature,
-            top_k=req.top_k,
-            top_p=req.top_p,
-            eos_token_id=eos,
-            seed=req.seed,
-        )
+        return gen_params_from(self.tokenizer, req)
 
     def _gather(self) -> list[GenerateRequest]:
         """Block briefly for one request, then drain the queue up to
@@ -129,12 +135,81 @@ class Worker:
             self.run_once()
 
 
+class ContinuousWorker:
+    """Serving loop over the continuous batcher: requests are admitted into
+    the running batch at token granularity (BASELINE.md config #5)."""
+
+    def __init__(
+        self,
+        engine: DecodeEngine,
+        broker: Broker,
+        tokenizer=None,
+        rows: int = 8,
+        poll_timeout_s: float = 0.02,
+    ):
+        from llmss_tpu.engine.scheduler import ContinuousBatcher
+
+        self.engine = engine
+        self.broker = broker
+        self.tokenizer = tokenizer
+        self.batcher = ContinuousBatcher(engine, rows=rows)
+        self.poll_timeout_s = poll_timeout_s
+
+    def _drain_broker(self) -> int:
+        n = 0
+        while True:
+            req = self.broker.pop_request(
+                timeout=self.poll_timeout_s if self.batcher.idle and n == 0
+                else 0.0
+            )
+            if req is None:
+                return n
+            try:
+                req.validate()
+                ids = encode_request(self.tokenizer, req)
+                gen = gen_params_from(self.tokenizer, req)
+            except Exception as e:  # noqa: BLE001 — per-request error surface
+                self.broker.push_response(
+                    GenerateResponse(id=req.id, error=str(e))
+                )
+                continue
+
+            def cb(toks, req=req):
+                text = (
+                    self.tokenizer.decode(toks)
+                    if self.tokenizer is not None else None
+                )
+                self.broker.push_response(
+                    GenerateResponse(
+                        id=req.id, prompt=req.prompt, continuation=text,
+                        token_ids=toks,
+                    )
+                )
+
+            self.batcher.submit(ids, gen, cb, req_id=req.id)
+            n += 1
+
+    def run_once(self) -> int:
+        n = self._drain_broker()
+        self.batcher.step()
+        return n
+
+    def run_forever(self, stop: threading.Event | None = None) -> None:
+        while stop is None or not stop.is_set():
+            self.run_once()
+
+
 def main(argv=None):
     import argparse
 
     parser = argparse.ArgumentParser("llmss-consumer")
     parser.add_argument("--pretrained_model_path", required=True)
     parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument(
+        "--continuous", action="store_true",
+        help="continuous batching (token-level admission) instead of "
+             "batch-at-a-time",
+    )
     parser.add_argument("--max_seq_len", type=int, default=None)
     parser.add_argument("--tp", type=int, default=None)
     parser.add_argument("--dp", type=int, default=1)
@@ -160,11 +235,14 @@ def main(argv=None):
         max_seq_len=args.max_seq_len or cfg.max_position_embeddings,
     )
     tokenizer = AutoTokenizer.from_pretrained(args.pretrained_model_path)
-    worker = Worker(
-        engine, RedisBroker(args.redis_host, args.redis_port), tokenizer,
-        batch_size=args.batch_size,
-    )
-    print("consumer serving")
+    broker = RedisBroker(args.redis_host, args.redis_port)
+    if args.continuous:
+        worker = ContinuousWorker(
+            engine, broker, tokenizer, rows=args.batch_size
+        )
+    else:
+        worker = Worker(engine, broker, tokenizer, batch_size=args.batch_size)
+    print("consumer serving" + (" (continuous batching)" if args.continuous else ""))
     worker.run_forever()
 
 
