@@ -1,0 +1,37 @@
+"""Weight pre-download CLI (≙ reference ``download_weights``,
+``hub.py:121-163``): fetch a model's safetensors (and tokenizer/config) into
+the local HF cache so serving starts offline."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("llmss-download")
+    parser.add_argument("model_id")
+    parser.add_argument("--revision", default=None)
+    parser.add_argument("--extension", default=".safetensors")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+
+    from huggingface_hub import hf_hub_download
+
+    from llmss_tpu.weights.hub import download_weights
+
+    files = download_weights(
+        args.model_id, revision=args.revision, extension=args.extension
+    )
+    for aux in ("config.json", "tokenizer.json", "tokenizer_config.json",
+                "special_tokens_map.json", "vocab.json", "merges.txt"):
+        try:
+            hf_hub_download(args.model_id, aux, revision=args.revision)
+        except Exception:  # noqa: BLE001 — aux files are best-effort
+            pass
+    print(f"downloaded {len(files)} weight file(s) for {args.model_id}")
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,7 @@ no-cache paths, ``consumer_server.py:123-166``). Differences by design:
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 
 import jax
@@ -77,12 +78,15 @@ class DecodeEngine:
         batch_size: int = 1,
         max_seq_len: int | None = None,
     ):
+        from llmss_tpu.utils.metrics import EngineMetrics
+
         self.cfg = cfg
         self.params = params
         self.mesh = mesh
         self.batch_size = batch_size
         self.max_seq_len = max_seq_len or cfg.max_position_embeddings
         self._cache_dtype = cfg.compute_dtype
+        self.metrics = EngineMetrics()
 
         self._prefill = jax.jit(
             partial(self._prefill_impl, cfg), donate_argnums=(2,),
@@ -222,10 +226,15 @@ class DecodeEngine:
         sample_args = self._sample_args(gens, B)
         key = jax.random.key(gens[0].seed)
 
-        tok, _, cache, key = self._prefill(
-            self.params, jnp.asarray(ids), cache, jnp.asarray(lens),
-            sample_args, key,
-        )
+        t_start = time.perf_counter()
+        with self.metrics.prefill.time():
+            tok, _, cache, key = self._prefill(
+                self.params, jnp.asarray(ids), cache, jnp.asarray(lens),
+                sample_args, key,
+            )
+            tok.block_until_ready()
+        self.metrics.ttft.record(time.perf_counter() - t_start)
+        self.metrics.add_request(B)
         eos = np.asarray(
             [g.eos_token_id if g.eos_token_id is not None else -1
              for g in gens]
@@ -249,10 +258,12 @@ class DecodeEngine:
                 on_token(step, tok_np)
             if done.all() or step == total_steps - 1:
                 break
-            tok, _, cache, key = self._decode(
-                self.params, tok, cache, cur_pos, sample_args, key
-            )
+            with self.metrics.decode_step.time():
+                tok, _, cache, key = self._decode(
+                    self.params, tok, cache, cur_pos, sample_args, key
+                )
             cur_pos = cur_pos + 1
+        self.metrics.add_tokens(sum(len(o) for o in out))
         return out
 
     def generate_fused(

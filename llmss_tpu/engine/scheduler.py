@@ -98,10 +98,16 @@ class ContinuousBatcher:
         scratch = self.engine.new_cache(1)
         sample_args = self.engine._sample_args(gen, 1)
         self._key, sub = jax.random.split(self._key)
-        tok, _, scratch, _ = self._prefill_row(
-            self.engine.params, jnp.asarray(padded), scratch,
-            jnp.asarray([len(ids)], jnp.int32), sample_args, sub,
-        )
+        m = self.engine.metrics
+        t0 = time.perf_counter()
+        with m.prefill.time():
+            tok, _, scratch, _ = self._prefill_row(
+                self.engine.params, jnp.asarray(padded), scratch,
+                jnp.asarray([len(ids)], jnp.int32), sample_args, sub,
+            )
+            tok.block_until_ready()
+        m.ttft.record(time.perf_counter() - t0)
+        m.add_request()
         self.cache = self._insert(self.cache, scratch, jnp.int32(row))
 
         first = int(np.asarray(tok)[0])
@@ -111,6 +117,7 @@ class ContinuousBatcher:
             self._finish(row, r)
             return True
         r.out.append(first)
+        m.add_tokens(1)
         self._tokens[row] = first
         self.active[row] = r
         if len(r.out) >= r.gen.max_new_tokens:
@@ -141,11 +148,12 @@ class ContinuousBatcher:
         for i, r in self.active.items():
             cur_pos[i] = r.cur_pos
         self._key, sub = jax.random.split(self._key)
-        tok, _, self.cache, _ = self.engine._decode(
-            self.engine.params, jnp.asarray(self._tokens), self.cache,
-            jnp.asarray(cur_pos), self._sample_args_all(), sub,
-        )
-        tok_np = np.asarray(tok)
+        with self.engine.metrics.decode_step.time():
+            tok, _, self.cache, _ = self.engine._decode(
+                self.engine.params, jnp.asarray(self._tokens), self.cache,
+                jnp.asarray(cur_pos), self._sample_args_all(), sub,
+            )
+            tok_np = np.asarray(tok)
 
         n = 0
         for i in list(self.active):
@@ -162,6 +170,7 @@ class ContinuousBatcher:
             if len(r.out) >= r.gen.max_new_tokens:
                 self._finish(i, r)
         self._step_count += 1
+        self.engine.metrics.add_tokens(n)
         return n
 
     @property

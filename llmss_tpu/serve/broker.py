@@ -33,6 +33,16 @@ class Broker(abc.ABC):
         self, request_id: str, timeout: float = 60.0
     ) -> GenerateResponse | None: ...
 
+    # Workers publish their metrics snapshot through the broker so the
+    # producer can serve GET /metrics even when producer and consumer are
+    # separate processes (the reference has no metrics surface at all,
+    # SURVEY.md §5).
+    def publish_metrics(self, metrics: dict) -> None:  # noqa: B027
+        pass
+
+    def read_metrics(self) -> dict:
+        return {}
+
 
 class InProcBroker(Broker):
     """stdlib-queue broker for tests and single-process serving."""
@@ -41,6 +51,13 @@ class InProcBroker(Broker):
         self._requests: queue.Queue[GenerateRequest] = queue.Queue()
         self._responses: dict[str, GenerateResponse] = {}
         self._cond = threading.Condition()
+        self._metrics: dict = {}
+
+    def publish_metrics(self, metrics: dict) -> None:
+        self._metrics = metrics
+
+    def read_metrics(self) -> dict:
+        return self._metrics
 
     def push_request(self, req: GenerateRequest) -> None:
         self._requests.put(req)
@@ -109,3 +126,14 @@ class RedisBroker(Broker):
     ) -> GenerateResponse | None:
         item = self._r.brpop(f"{self._prefix}:{request_id}", timeout=timeout)
         return GenerateResponse.from_json(item[1]) if item else None
+
+    def publish_metrics(self, metrics: dict) -> None:
+        import json
+
+        self._r.set("llmss:metrics", json.dumps(metrics), ex=120)
+
+    def read_metrics(self) -> dict:
+        import json
+
+        raw = self._r.get("llmss:metrics")
+        return json.loads(raw) if raw else {}

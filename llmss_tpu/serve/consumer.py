@@ -111,6 +111,7 @@ class Worker:
             outs = self.engine.generate(prompts, gens)
         except Exception as e:  # noqa: BLE001 — batch failure containment
             logger.exception("batch failed")
+            self.engine.metrics.add_error(len(ok))
             for req in ok:
                 self.broker.push_response(
                     GenerateResponse(id=req.id, error=f"engine error: {e}")
@@ -128,6 +129,7 @@ class Worker:
                     token_ids=toks,
                 )
             )
+        self.broker.publish_metrics(self.engine.metrics.to_dict())
         return len(batch)
 
     def run_forever(self, stop: threading.Event | None = None) -> None:
@@ -192,6 +194,9 @@ class ContinuousWorker:
     def run_once(self) -> int:
         n = self._drain_broker()
         self.batcher.step()
+        self._publish_counter = getattr(self, "_publish_counter", 0) + 1
+        if n or self._publish_counter % 64 == 0:
+            self.broker.publish_metrics(self.engine.metrics.to_dict())
         return n
 
     def run_forever(self, stop: threading.Event | None = None) -> None:

@@ -41,6 +41,8 @@ class ProducerServer:
             def do_GET(self):
                 if self.path == "/health":
                     self._reply(200, {"status": "ok"})
+                elif self.path == "/metrics":
+                    self._reply(200, outer.broker.read_metrics())
                 else:
                     self._reply(404, {"error": "not found"})
 

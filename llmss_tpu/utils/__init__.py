@@ -1,0 +1,5 @@
+"""Cross-cutting utilities: metrics, structured logging, profiling."""
+
+from llmss_tpu.utils.metrics import EngineMetrics, LatencyStat, profile_trace
+
+__all__ = ["EngineMetrics", "LatencyStat", "profile_trace"]

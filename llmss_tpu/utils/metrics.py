@@ -1,0 +1,126 @@
+"""First-class serving metrics + profiler hooks.
+
+The reference's only measurement is a wall-clock print on rank 0
+(``generate.py:44-45,192-194`` — SURVEY.md §5 "Tracing/profiling: absent").
+Here TTFT and per-token latency are first-class: the engine records
+percentile stats for every phase, the serving stack exposes them over
+``GET /metrics``, and ``profile_trace`` wraps ``jax.profiler`` for on-demand
+TPU traces (the BASELINE.md north-star is stated in exactly these units:
+tokens/sec/chip and p50 TTFT).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+
+class LatencyStat:
+    """Bounded-reservoir latency recorder with percentile readout."""
+
+    def __init__(self, name: str, max_samples: int = 4096):
+        self.name = name
+        self.max_samples = max_samples
+        self._samples: list[float] = []
+        self._count = 0
+        self._total = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._total += seconds
+            if len(self._samples) >= self.max_samples:
+                # overwrite pseudo-randomly to keep a sliding reservoir
+                self._samples[self._count % self.max_samples] = seconds
+            else:
+                self._samples.append(seconds)
+
+    @contextlib.contextmanager
+    def time(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(time.perf_counter() - t0)
+
+    def percentile(self, q: float) -> float | None:
+        with self._lock:
+            if not self._samples:
+                return None
+            s = sorted(self._samples)
+            idx = min(int(q / 100.0 * len(s)), len(s) - 1)
+            return s[idx]
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            n = self._count
+            mean = self._total / n if n else None
+        return {
+            "count": n,
+            "mean_ms": round(mean * 1e3, 3) if mean is not None else None,
+            "p50_ms": _ms(self.percentile(50)),
+            "p95_ms": _ms(self.percentile(95)),
+            "p99_ms": _ms(self.percentile(99)),
+        }
+
+
+def _ms(v: float | None) -> float | None:
+    return round(v * 1e3, 3) if v is not None else None
+
+
+class EngineMetrics:
+    """Aggregated counters for one engine/worker."""
+
+    def __init__(self):
+        self.ttft = LatencyStat("ttft")
+        self.decode_step = LatencyStat("decode_step")
+        self.prefill = LatencyStat("prefill")
+        self._lock = threading.Lock()
+        self.tokens_generated = 0
+        self.requests_served = 0
+        self.errors = 0
+        self._start = time.time()
+
+    def add_tokens(self, n: int) -> None:
+        with self._lock:
+            self.tokens_generated += n
+
+    def add_request(self, n: int = 1) -> None:
+        with self._lock:
+            self.requests_served += n
+
+    def add_error(self, n: int = 1) -> None:
+        with self._lock:
+            self.errors += n
+
+    def to_dict(self) -> dict:
+        uptime = time.time() - self._start
+        with self._lock:
+            toks, reqs, errs = (
+                self.tokens_generated, self.requests_served, self.errors
+            )
+        return {
+            "uptime_s": round(uptime, 1),
+            "requests_served": reqs,
+            "tokens_generated": toks,
+            "errors": errs,
+            "tokens_per_sec_lifetime": round(toks / uptime, 2) if uptime else 0,
+            "ttft": self.ttft.to_dict(),
+            "prefill": self.prefill.to_dict(),
+            "decode_step": self.decode_step.to_dict(),
+        }
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """Capture a TPU profiler trace for the enclosed block
+    (view with tensorboard / xprof)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,61 @@
+"""Metrics: stats math + engine/serving integration."""
+
+import numpy as np
+
+from llmss_tpu.utils.metrics import EngineMetrics, LatencyStat
+
+
+def test_latency_stat_percentiles():
+    s = LatencyStat("x")
+    for v in [0.01, 0.02, 0.03, 0.04, 0.1]:
+        s.record(v)
+    d = s.to_dict()
+    assert d["count"] == 5
+    assert d["p50_ms"] == 30.0
+    assert d["p99_ms"] == 100.0
+    assert abs(d["mean_ms"] - 40.0) < 1e-6
+
+
+def test_engine_metrics_shape():
+    m = EngineMetrics()
+    m.add_request(2)
+    m.add_tokens(10)
+    m.ttft.record(0.05)
+    d = m.to_dict()
+    assert d["requests_served"] == 2
+    assert d["tokens_generated"] == 10
+    assert d["ttft"]["count"] == 1
+
+
+def test_engine_records_metrics(tmp_path, devices):
+    import torch
+    import transformers as tr
+
+    from llmss_tpu.engine import DecodeEngine, GenerationParams
+    from llmss_tpu.models import config_from_hf
+    from llmss_tpu.models.registry import MODEL_REGISTRY
+    from llmss_tpu.parallel import MeshPlan, make_mesh
+    from llmss_tpu.weights import CheckpointShards, weight_files
+
+    torch.manual_seed(1)
+    cfg_hf = tr.GPT2Config(
+        vocab_size=64, n_positions=64, n_embd=32, n_layer=2, n_head=4
+    )
+    d = tmp_path / "m"
+    tr.GPT2LMHeadModel(cfg_hf).eval().save_pretrained(
+        d, safe_serialization=True
+    )
+    from transformers import AutoConfig
+
+    mesh = make_mesh(MeshPlan(dp=2, tp=4))
+    cfg = config_from_hf(AutoConfig.from_pretrained(d), dtype="float32")
+    ckpt = CheckpointShards(weight_files(str(d)), dtype=np.float32)
+    params = MODEL_REGISTRY["gpt2"].load_params(ckpt, cfg, mesh)
+    engine = DecodeEngine(cfg, params, mesh, max_seq_len=64)
+
+    engine.generate([[1, 2, 3]], GenerationParams(max_new_tokens=5))
+    m = engine.metrics.to_dict()
+    assert m["requests_served"] == 1
+    assert m["tokens_generated"] == 5
+    assert m["ttft"]["count"] == 1
+    assert m["decode_step"]["count"] == 4
