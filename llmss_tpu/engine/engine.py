@@ -88,14 +88,16 @@ class DecodeEngine:
         self._cache_dtype = cfg.compute_dtype
         self.metrics = EngineMetrics()
 
+        # mesh is partial-bound (a compile-time constant, not a traced arg):
+        # it enables the shard_map'd Pallas attention path inside forward.
         self._prefill = jax.jit(
-            partial(self._prefill_impl, cfg), donate_argnums=(2,),
+            partial(self._prefill_impl, cfg, mesh), donate_argnums=(2,),
         )
         self._decode = jax.jit(
-            partial(self._decode_impl, cfg), donate_argnums=(2,),
+            partial(self._decode_impl, cfg, mesh), donate_argnums=(2,),
         )
         self._decode_many = jax.jit(
-            partial(self._decode_many_impl, cfg),
+            partial(self._decode_many_impl, cfg, mesh),
             donate_argnums=(2,),
             static_argnames=("n_steps",),
         )
@@ -103,7 +105,8 @@ class DecodeEngine:
     # -- jitted bodies ------------------------------------------------------
 
     @staticmethod
-    def _prefill_impl(cfg, params, ids, cache, prompt_lens, sample_args, key):
+    def _prefill_impl(cfg, mesh, params, ids, cache, prompt_lens, sample_args,
+                      key):
         B, S = ids.shape
         positions = jnp.broadcast_to(
             jnp.arange(S, dtype=jnp.int32), (B, S)
@@ -113,20 +116,21 @@ class DecodeEngine:
         kv_pos = jnp.where(valid, positions, -1)
         logits, cache = forward(
             cfg, params, ids, positions, cache, slots,
-            gather_idx=prompt_lens - 1, kv_write_positions=kv_pos,
+            gather_idx=prompt_lens - 1, kv_write_positions=kv_pos, mesh=mesh,
         )
         key, sub = jax.random.split(key)
         tok = sample(logits[:, 0], sub, **sample_args)
         return tok, logits[:, 0], cache, key
 
     @staticmethod
-    def _decode_impl(cfg, params, tokens, cache, cur_pos, sample_args, key):
+    def _decode_impl(cfg, mesh, params, tokens, cache, cur_pos, sample_args,
+                     key):
         # tokens [B], cur_pos [B] — position at which each token sits.
         positions = cur_pos[:, None]
         slots = positions % cache.max_len
         logits, cache = forward(
             cfg, params, tokens[:, None], positions, cache, slots,
-            last_only=True,
+            last_only=True, mesh=mesh,
         )
         key, sub = jax.random.split(key)
         tok = sample(logits[:, 0], sub, **sample_args)
@@ -134,8 +138,8 @@ class DecodeEngine:
 
     @staticmethod
     def _decode_many_impl(
-        cfg, params, tokens, cache, cur_pos, sample_args, key, done, eos,
-        *, n_steps: int,
+        cfg, mesh, params, tokens, cache, cur_pos, sample_args, key, done,
+        eos, *, n_steps: int,
     ):
         """Fused multi-token decode: lax.scan over the single-token step."""
 
@@ -145,7 +149,7 @@ class DecodeEngine:
             slots = positions % cache.max_len
             logits, cache = forward(
                 cfg, params, tokens[:, None], positions, cache, slots,
-                last_only=True,
+                last_only=True, mesh=mesh,
             )
             key, sub = jax.random.split(key)
             tok = sample(logits[:, 0], sub, **sample_args)
@@ -262,6 +266,11 @@ class DecodeEngine:
                 tok, _, cache, key = self._decode(
                     self.params, tok, cache, cur_pos, sample_args, key
                 )
+                # Sync inside the timer: dispatch is async, so without this
+                # the stat would record ~µs dispatch overhead, not step
+                # latency. The loop reads the token next iteration anyway,
+                # so this costs nothing.
+                tok.block_until_ready()
             cur_pos = cur_pos + 1
         self.metrics.add_tokens(sum(len(o) for o in out))
         return out
@@ -281,10 +290,15 @@ class DecodeEngine:
         sample_args = self._sample_args(gen, B)
         key = jax.random.key(gen.seed)
 
-        tok, _, cache, key = self._prefill(
-            self.params, jnp.asarray(ids), cache, jnp.asarray(lens),
-            sample_args, key,
-        )
+        t_start = time.perf_counter()
+        with self.metrics.prefill.time():
+            tok, _, cache, key = self._prefill(
+                self.params, jnp.asarray(ids), cache, jnp.asarray(lens),
+                sample_args, key,
+            )
+            tok.block_until_ready()
+        self.metrics.ttft.record(time.perf_counter() - t_start)
+        self.metrics.add_request(B)
         eos = jnp.int32(
             gen.eos_token_id if gen.eos_token_id is not None else -1
         )
@@ -300,4 +314,5 @@ class DecodeEngine:
         for row in all_toks:
             stop = np.where(row == int(eos))[0]
             out.append(row[: stop[0]].tolist() if stop.size else row.tolist())
+        self.metrics.add_tokens(sum(len(o) for o in out))
         return out

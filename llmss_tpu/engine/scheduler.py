@@ -59,7 +59,8 @@ class ContinuousBatcher:
         cfg = engine.cfg
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
         self._prefill_row = jax.jit(
-            partial(DecodeEngine._prefill_impl, cfg), donate_argnums=(2,),
+            partial(DecodeEngine._prefill_impl, cfg, engine.mesh),
+            donate_argnums=(2,),
         )
 
     @staticmethod

@@ -31,7 +31,7 @@ from jax.sharding import PartitionSpec as P
 
 from llmss_tpu.engine.cache import KVCache, write_layer, write_positions
 from llmss_tpu.models.common import DecoderConfig, act_fn
-from llmss_tpu.ops.attention import attention, make_causal_mask
+from llmss_tpu.ops.attention import dispatch_attention, make_causal_mask
 from llmss_tpu.ops.layers import LinearParams, NormParams, dense, embedding
 from llmss_tpu.ops.rope import apply_rope
 from llmss_tpu.parallel.mesh import AXIS_DP, AXIS_TP
@@ -210,6 +210,7 @@ def _block(
     kv_positions: jax.Array,  # [B, T] (already includes current tokens)
     slots: jax.Array,  # [B, S]
     mask: jax.Array,  # [B, S, T]
+    mesh=None,
 ):
     B, S, E = h.shape
     Hq, Hkv, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -235,7 +236,10 @@ def _block(
 
     k_cache, v_cache = write_layer(k_cache, v_cache, k, v, slots)
 
-    attn = attention(q, k_cache, v_cache, mask, scale=cfg.attn_scale)
+    attn = dispatch_attention(
+        q, k_cache, v_cache, mask=mask, q_positions=positions,
+        kv_positions=kv_positions, scale=cfg.attn_scale, mesh=mesh,
+    )
     attn = dense(attn.reshape(B, S, Hq * D), bp["o"])
     attn = constrain(attn, P(AXIS_DP, None, None))
 
@@ -262,6 +266,7 @@ def forward(
     last_only: bool = False,
     gather_idx: jax.Array | None = None,  # [B] per-row index into S
     kv_write_positions: jax.Array | None = None,  # [B, S]; -1 marks padding
+    mesh=None,  # enables the Pallas attention path (shard_map needs a Mesh)
 ) -> tuple[jax.Array, KVCache]:
     """Run the decoder; returns (logits fp32, updated cache).
 
@@ -293,7 +298,8 @@ def forward(
     def body(h, xs):
         bp, k_l, v_l = xs
         h, k_l, v_l = _block(
-            cfg, bp, h, positions, k_l, v_l, new_kv_positions, slots, mask
+            cfg, bp, h, positions, k_l, v_l, new_kv_positions, slots, mask,
+            mesh=mesh,
         )
         return h, (k_l, v_l)
 

@@ -17,10 +17,18 @@ replicated sharding spec on the KV projection).
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+# Attention implementation override: "xla" | "pallas" | None (auto).
+# Env var LLMSS_ATTN_IMPL or set directly (tests force "pallas" to exercise
+# the kernel in interpret mode on CPU).
+IMPL_OVERRIDE: str | None = os.environ.get("LLMSS_ATTN_IMPL") or None
 
 
 def make_causal_mask(
@@ -66,3 +74,67 @@ def attention(
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkgst,btkd->bskgd", probs, v.astype(jnp.float32))
     return out.reshape(B, S, Hq, D).astype(q.dtype)
+
+
+def dispatch_attention(
+    q: jax.Array,  # [B, S, Hq, D]
+    k: jax.Array,  # [B, T, Hkv, D]
+    v: jax.Array,  # [B, T, Hkv, D]
+    *,
+    mask: jax.Array,  # [B, S, T] bool (XLA path)
+    q_positions: jax.Array,  # [B, S] (pallas path)
+    kv_positions: jax.Array,  # [B, T] (pallas path)
+    scale: float | None = None,
+    mesh=None,
+) -> jax.Array:
+    """Route to the Pallas flash kernel (TPU, prefill-sized S) or the XLA
+    einsum path. Both implement identical semantics; the mask and the
+    position pair are two encodings of the same constraint."""
+    from llmss_tpu.ops import pallas_attention
+
+    B, S, Hq, D = q.shape
+    T, Hkv = k.shape[1], k.shape[2]
+    impl = IMPL_OVERRIDE
+    if impl is None:
+        impl = (
+            "pallas"
+            if jax.default_backend() == "tpu"
+            and mesh is not None
+            and pallas_attention.supports(S, T, Hq, Hkv)
+            else "xla"
+        )
+    if impl == "pallas" and mesh is not None:
+        from llmss_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
+
+        dp, sp, tp = (
+            mesh.shape[AXIS_DP], mesh.shape[AXIS_SP], mesh.shape[AXIS_TP]
+        )
+        kv_shard = Hkv % tp == 0
+        # Replicated-KV is only correct for MQA (Hkv == 1): the kernel
+        # derives query→KV grouping from *local* shapes, which matches the
+        # global grouping only when KV heads are sharded alongside the
+        # query heads or there is a single shared KV head.
+        shardable = (
+            sp == 1
+            and B % dp == 0
+            and Hq % tp == 0
+            and (kv_shard or Hkv == 1)
+            and pallas_attention.supports(S, T, Hq, Hkv)
+        )
+        if shardable:
+            kv_ax = AXIS_TP if kv_shard else None
+            qs = P(AXIS_DP, None, AXIS_TP, None)
+            ks = P(AXIS_DP, None, kv_ax, None)
+            ps = P(AXIS_DP, None)
+            interp = jax.default_backend() != "tpu"
+
+            def local(q, k, v, qp, kvp):
+                return pallas_attention.flash_attention(
+                    q, k, v, qp, kvp, scale=scale, interpret=interp
+                )
+
+            return jax.shard_map(
+                local, mesh=mesh, in_specs=(qs, ks, ks, ps, ps),
+                out_specs=qs, check_vma=False,
+            )(q, k, v, q_positions, kv_positions)
+    return attention(q, k, v, mask, scale=scale)

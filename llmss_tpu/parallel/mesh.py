@@ -140,5 +140,5 @@ def default_compute_dtype() -> jnp.dtype:
     """
     platform = jax.default_backend()
     if platform == "cpu":
-        return jnp.float32
-    return jnp.bfloat16
+        return jnp.dtype("float32")
+    return jnp.dtype("bfloat16")

@@ -116,6 +116,9 @@ class Worker:
                 self.broker.push_response(
                     GenerateResponse(id=req.id, error=f"engine error: {e}")
                 )
+            # Persistent failures must be visible to operators immediately,
+            # not only after the next successful batch.
+            self.broker.publish_metrics(self.engine.metrics.to_dict())
             return len(batch)
 
         for req, toks in zip(ok, outs):
@@ -156,6 +159,7 @@ class ContinuousWorker:
         self.tokenizer = tokenizer
         self.batcher = ContinuousBatcher(engine, rows=rows)
         self.poll_timeout_s = poll_timeout_s
+        self._publish_counter = 0
 
     def _drain_broker(self) -> int:
         n = 0
@@ -194,7 +198,7 @@ class ContinuousWorker:
     def run_once(self) -> int:
         n = self._drain_broker()
         self.batcher.step()
-        self._publish_counter = getattr(self, "_publish_counter", 0) + 1
+        self._publish_counter += 1
         if n or self._publish_counter % 64 == 0:
             self.broker.publish_metrics(self.engine.metrics.to_dict())
         return n

@@ -45,24 +45,27 @@ class LatencyStat:
         finally:
             self.record(time.perf_counter() - t0)
 
+    @staticmethod
+    def _pick(s: list[float], q: float) -> float | None:
+        if not s:
+            return None
+        return s[min(int(q / 100.0 * len(s)), len(s) - 1)]
+
     def percentile(self, q: float) -> float | None:
         with self._lock:
-            if not self._samples:
-                return None
-            s = sorted(self._samples)
-            idx = min(int(q / 100.0 * len(s)), len(s) - 1)
-            return s[idx]
+            return self._pick(sorted(self._samples), q)
 
     def to_dict(self) -> dict:
         with self._lock:
             n = self._count
             mean = self._total / n if n else None
+            s = sorted(self._samples)
         return {
             "count": n,
             "mean_ms": round(mean * 1e3, 3) if mean is not None else None,
-            "p50_ms": _ms(self.percentile(50)),
-            "p95_ms": _ms(self.percentile(95)),
-            "p99_ms": _ms(self.percentile(99)),
+            "p50_ms": _ms(self._pick(s, 50)),
+            "p95_ms": _ms(self._pick(s, 95)),
+            "p99_ms": _ms(self._pick(s, 99)),
         }
 
 
