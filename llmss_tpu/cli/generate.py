@@ -44,6 +44,10 @@ def get_args(argv=None):
     )
     parser.add_argument("--tp", type=int, default=None)
     parser.add_argument("--dp", type=int, default=1)
+    parser.add_argument(
+        "--sp", type=int, default=1,
+        help="sequence-parallel axis (ring-attention long-context prefill)",
+    )
     parser.add_argument("--dtype", type=str, default=None)
     parser.add_argument("--max_seq_len", type=int, default=None)
     parser.add_argument("--seed", type=int, default=0)
@@ -71,7 +75,7 @@ def main(argv=None):
     )
 
     initialize_runtime()
-    mesh = make_mesh(MeshPlan(dp=args.dp, tp=args.tp))
+    mesh = make_mesh(MeshPlan(dp=args.dp, sp=args.sp, tp=args.tp))
     dtype = args.dtype or str(default_compute_dtype())
     cfg, params = load_model(args.pretrained_model_path, mesh, dtype=dtype)
 

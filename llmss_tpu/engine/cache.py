@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from llmss_tpu.parallel.mesh import AXIS_DP, AXIS_TP
+from llmss_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
 
 
 class KVCache(NamedTuple):
@@ -37,16 +37,22 @@ class KVCache(NamedTuple):
         return self.k.shape[2]
 
 
-def cache_specs(n_kv_heads: int, tp: int, *, batch_dp: bool = True) -> KVCache:
+def cache_specs(
+    n_kv_heads: int, tp: int, *, batch_dp: bool = True, seq_sp: bool = False
+) -> KVCache:
     """PartitionSpecs for the cache pytree.
 
     ``batch_dp=False`` replicates the batch dim (needed when the live batch
-    is smaller than the dp axis).
+    is smaller than the dp axis). ``seq_sp=True`` shards the sequence dim
+    over ``sp`` — the long-context layout (context scales with chips; ring /
+    split-KV attention reads it, absent entirely in the reference,
+    SURVEY.md §5).
     """
     head_axis = AXIS_TP if n_kv_heads % tp == 0 else None
     dp_axis = AXIS_DP if batch_dp else None
-    kv = P(None, dp_axis, None, head_axis, None)
-    return KVCache(k=kv, v=kv, positions=P(dp_axis, None))
+    seq_axis = AXIS_SP if seq_sp else None
+    kv = P(None, dp_axis, seq_axis, head_axis, None)
+    return KVCache(k=kv, v=kv, positions=P(dp_axis, seq_axis))
 
 
 def init_cache(
@@ -63,6 +69,7 @@ def init_cache(
         n_kv_heads,
         mesh.shape[AXIS_TP],
         batch_dp=batch % mesh.shape[AXIS_DP] == 0,
+        seq_sp=mesh.shape[AXIS_SP] > 1 and max_len % mesh.shape[AXIS_SP] == 0,
     )
     shape = (n_layers, batch, max_len, n_kv_heads, head_dim)
 

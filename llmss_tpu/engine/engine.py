@@ -166,6 +166,18 @@ class DecodeEngine:
 
     # -- host API -----------------------------------------------------------
 
+    def timed_prefill(self, prefill_fn, *args, batch: int):
+        """Run a jitted prefill, recording prefill latency, TTFT, and the
+        request count (one definition for all prefill sites: generate,
+        generate_fused, and the continuous batcher's row admission)."""
+        t0 = time.perf_counter()
+        with self.metrics.prefill.time():
+            out = prefill_fn(*args)
+            out[0].block_until_ready()
+        self.metrics.ttft.record(time.perf_counter() - t0)
+        self.metrics.add_request(batch)
+        return out
+
     def new_cache(self, batch: int | None = None) -> KVCache:
         return init_cache(
             self.mesh,
@@ -230,15 +242,10 @@ class DecodeEngine:
         sample_args = self._sample_args(gens, B)
         key = jax.random.key(gens[0].seed)
 
-        t_start = time.perf_counter()
-        with self.metrics.prefill.time():
-            tok, _, cache, key = self._prefill(
-                self.params, jnp.asarray(ids), cache, jnp.asarray(lens),
-                sample_args, key,
-            )
-            tok.block_until_ready()
-        self.metrics.ttft.record(time.perf_counter() - t_start)
-        self.metrics.add_request(B)
+        tok, _, cache, key = self.timed_prefill(
+            self._prefill, self.params, jnp.asarray(ids), cache,
+            jnp.asarray(lens), sample_args, key, batch=B,
+        )
         eos = np.asarray(
             [g.eos_token_id if g.eos_token_id is not None else -1
              for g in gens]
@@ -290,15 +297,10 @@ class DecodeEngine:
         sample_args = self._sample_args(gen, B)
         key = jax.random.key(gen.seed)
 
-        t_start = time.perf_counter()
-        with self.metrics.prefill.time():
-            tok, _, cache, key = self._prefill(
-                self.params, jnp.asarray(ids), cache, jnp.asarray(lens),
-                sample_args, key,
-            )
-            tok.block_until_ready()
-        self.metrics.ttft.record(time.perf_counter() - t_start)
-        self.metrics.add_request(B)
+        tok, _, cache, key = self.timed_prefill(
+            self._prefill, self.params, jnp.asarray(ids), cache,
+            jnp.asarray(lens), sample_args, key, batch=B,
+        )
         eos = jnp.int32(
             gen.eos_token_id if gen.eos_token_id is not None else -1
         )

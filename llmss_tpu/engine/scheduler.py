@@ -99,16 +99,11 @@ class ContinuousBatcher:
         scratch = self.engine.new_cache(1)
         sample_args = self.engine._sample_args(gen, 1)
         self._key, sub = jax.random.split(self._key)
-        m = self.engine.metrics
-        t0 = time.perf_counter()
-        with m.prefill.time():
-            tok, _, scratch, _ = self._prefill_row(
-                self.engine.params, jnp.asarray(padded), scratch,
-                jnp.asarray([len(ids)], jnp.int32), sample_args, sub,
-            )
-            tok.block_until_ready()
-        m.ttft.record(time.perf_counter() - t0)
-        m.add_request()
+        tok, _, scratch, _ = self.engine.timed_prefill(
+            self._prefill_row, self.engine.params, jnp.asarray(padded),
+            scratch, jnp.asarray([len(ids)], jnp.int32), sample_args, sub,
+            batch=1,
+        )
         self.cache = self._insert(self.cache, scratch, jnp.int32(row))
 
         first = int(np.asarray(tok)[0])
@@ -118,7 +113,7 @@ class ContinuousBatcher:
             self._finish(row, r)
             return True
         r.out.append(first)
-        m.add_tokens(1)
+        self.engine.metrics.add_tokens(1)
         self._tokens[row] = first
         self.active[row] = r
         if len(r.out) >= r.gen.max_new_tokens:

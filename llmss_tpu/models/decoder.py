@@ -34,8 +34,18 @@ from llmss_tpu.models.common import DecoderConfig, act_fn
 from llmss_tpu.ops.attention import dispatch_attention, make_causal_mask
 from llmss_tpu.ops.layers import LinearParams, NormParams, dense, embedding
 from llmss_tpu.ops.rope import apply_rope
-from llmss_tpu.parallel.mesh import AXIS_DP, AXIS_TP
+from llmss_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
 from llmss_tpu.parallel.sharding import constrain
+
+
+def _seq_axis(mesh, S: int) -> str | None:
+    """Shard the sequence dim over ``sp`` when the mesh has a live sp axis
+    and the length divides (long-context prefill); decode (S=1) and odd
+    lengths stay replicated."""
+    if mesh is None or S <= 1:
+        return None
+    sp = mesh.shape[AXIS_SP]
+    return AXIS_SP if sp > 1 and S % sp == 0 else None
 
 Params = dict[str, Any]
 
@@ -214,8 +224,9 @@ def _block(
 ):
     B, S, E = h.shape
     Hq, Hkv, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    head_spec = P(AXIS_DP, None, AXIS_TP, None)
-    kv_spec = head_spec if Hkv > 1 else P(AXIS_DP, None, None, None)
+    seq_ax = _seq_axis(mesh, S)
+    head_spec = P(AXIS_DP, seq_ax, AXIS_TP, None)
+    kv_spec = head_spec if Hkv > 1 else P(AXIS_DP, seq_ax, None, None)
 
     res = h
     x = _norm(cfg, h, bp["ln1"])
@@ -241,7 +252,7 @@ def _block(
         kv_positions=kv_positions, scale=cfg.attn_scale, mesh=mesh,
     )
     attn = dense(attn.reshape(B, S, Hq * D), bp["o"])
-    attn = constrain(attn, P(AXIS_DP, None, None))
+    attn = constrain(attn, P(AXIS_DP, seq_ax, None))
 
     if cfg.parallel_residual:
         # GPT-J form: one pre-LN feeds both branches; residual adds both
@@ -251,7 +262,7 @@ def _block(
         h = res + attn
         x2 = _norm(cfg, h, bp["ln2"])
         h = h + _mlp(cfg, bp, x2)
-    h = constrain(h, P(AXIS_DP, None, None))
+    h = constrain(h, P(AXIS_DP, seq_ax, None))
     return h, k_cache, v_cache
 
 
@@ -287,7 +298,7 @@ def forward(
     h = embedding(input_ids, params["wte"].astype(dtype), one_hot=True)
     if cfg.positions == "learned":
         h = h + embedding(positions, params["wpe"].astype(dtype), one_hot=True)
-    h = constrain(h, P(AXIS_DP, None, None))
+    h = constrain(h, P(AXIS_DP, _seq_axis(mesh, h.shape[1]), None))
 
     if kv_write_positions is None:
         kv_write_positions = positions

@@ -25,9 +25,11 @@ from jax.sharding import PartitionSpec as P
 
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
-# Attention implementation override: "xla" | "pallas" | None (auto).
+# Attention implementation override: "xla" | "pallas" | "ring" | None (auto).
 # Env var LLMSS_ATTN_IMPL or set directly (tests force "pallas" to exercise
-# the kernel in interpret mode on CPU).
+# the kernel in interpret mode on CPU). "pallas" disables the sp ring path
+# (the kernel is single-shard: A/B it against "xla" on an sp=1 mesh);
+# "ring" requires an sp>1 mesh.
 IMPL_OVERRIDE: str | None = os.environ.get("LLMSS_ATTN_IMPL") or None
 
 
@@ -87,42 +89,75 @@ def dispatch_attention(
     scale: float | None = None,
     mesh=None,
 ) -> jax.Array:
-    """Route to the Pallas flash kernel (TPU, prefill-sized S) or the XLA
-    einsum path. Both implement identical semantics; the mask and the
-    position pair are two encodings of the same constraint."""
-    from llmss_tpu.ops import pallas_attention
+    """Route to the right implementation:
+
+    - ``sp > 1`` mesh → sequence-parallel ring attention (prefill) or
+      split-KV LSE-merge attention (decode) inside ``shard_map``;
+    - TPU + prefill-sized S → Pallas flash kernel inside ``shard_map``;
+    - otherwise → XLA einsum path with the materialized mask.
+
+    All paths implement identical semantics; the mask and the position pair
+    are two encodings of the same constraint."""
+    from llmss_tpu.ops import pallas_attention, ring_attention as ring_mod
 
     B, S, Hq, D = q.shape
     T, Hkv = k.shape[1], k.shape[2]
-    impl = IMPL_OVERRIDE
-    if impl is None:
-        impl = (
-            "pallas"
-            if jax.default_backend() == "tpu"
-            and mesh is not None
-            and pallas_attention.supports(S, T, Hq, Hkv)
-            else "xla"
-        )
-    if impl == "pallas" and mesh is not None:
+    force = IMPL_OVERRIDE
+    if mesh is not None and force != "xla":
         from llmss_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
 
         dp, sp, tp = (
             mesh.shape[AXIS_DP], mesh.shape[AXIS_SP], mesh.shape[AXIS_TP]
         )
         kv_shard = Hkv % tp == 0
-        # Replicated-KV is only correct for MQA (Hkv == 1): the kernel
-        # derives query→KV grouping from *local* shapes, which matches the
-        # global grouping only when KV heads are sharded alongside the
-        # query heads or there is a single shared KV head.
-        shardable = (
+        # Replicated-KV sharding is only correct for MQA (Hkv == 1): local
+        # head grouping matches global grouping only when KV heads shard
+        # alongside query heads or there is a single shared KV head.
+        heads_ok = Hq % tp == 0 and (kv_shard or Hkv == 1)
+        kv_ax = AXIS_TP if kv_shard else None
+
+        sp_ok = (
+            force in (None, "ring")
+            and sp > 1 and T % sp == 0 and B % dp == 0 and heads_ok
+        )
+        if force == "ring" and not sp_ok:
+            # A silent fallback would make an A/B run measure the wrong
+            # implementation; forcing ring demands a satisfiable sp mesh.
+            # ("pallas" keeps its documented graceful fallback: decode
+            # steps are unsupported by design and must still run.)
+            raise ValueError(
+                "LLMSS_ATTN_IMPL=ring requires sp>1, T % sp == 0, "
+                f"B % dp == 0 and shardable heads; got sp={sp}, T={T}, "
+                f"B={B}, dp={dp}, Hq={Hq}, Hkv={Hkv}, tp={tp}"
+            )
+        if sp_ok:
+            # Sequence-parallel path: KV (the cache) sharded over sp.
+            ring = S > 1 and S % sp == 0
+            q_seq_ax = AXIS_SP if ring else None
+            fn = ring_mod.ring_attention if ring else (
+                ring_mod.lse_merge_attention
+            )
+            qs = P(AXIS_DP, q_seq_ax, AXIS_TP, None)
+            ks = P(AXIS_DP, AXIS_SP, kv_ax, None)
+
+            def local_sp(q, k, v, qp, kvp):
+                return fn(q, k, v, qp, kvp, axis_name=AXIS_SP, scale=scale)
+
+            return jax.shard_map(
+                local_sp, mesh=mesh,
+                in_specs=(qs, ks, ks, P(AXIS_DP, q_seq_ax),
+                          P(AXIS_DP, AXIS_SP)),
+                out_specs=qs, check_vma=False,
+            )(q, k, v, q_positions, kv_positions)
+
+        pallas_ok = (
             sp == 1
             and B % dp == 0
-            and Hq % tp == 0
-            and (kv_shard or Hkv == 1)
+            and heads_ok
             and pallas_attention.supports(S, T, Hq, Hkv)
+            and (force == "pallas" or jax.default_backend() == "tpu")
         )
-        if shardable:
-            kv_ax = AXIS_TP if kv_shard else None
+        if pallas_ok:
             qs = P(AXIS_DP, None, AXIS_TP, None)
             ks = P(AXIS_DP, None, kv_ax, None)
             ps = P(AXIS_DP, None)

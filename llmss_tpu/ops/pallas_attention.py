@@ -109,8 +109,9 @@ def _kernel(
 def supports(S: int, T: int, Hq: int, Hkv: int, *, min_q: int = 16) -> bool:
     """Whether the kernel is worth dispatching to (else caller uses the XLA
     einsum path). Decode steps (S=1) stay on XLA: they are HBM-bound gathers
-    with no score tensor to avoid."""
-    return S >= min_q and S % 8 == 0 and Hq % Hkv == 0
+    with no score tensor to avoid. Odd T would degrade the KV block size
+    toward 1 (a T-step sequential grid) — require lane-friendly lengths."""
+    return S >= min_q and S % 8 == 0 and T % 8 == 0 and Hq % Hkv == 0
 
 
 @functools.partial(

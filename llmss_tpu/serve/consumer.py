@@ -222,6 +222,11 @@ def main(argv=None):
     parser.add_argument("--max_seq_len", type=int, default=None)
     parser.add_argument("--tp", type=int, default=None)
     parser.add_argument("--dp", type=int, default=1)
+    parser.add_argument(
+        "--sp", type=int, default=1,
+        help="sequence-parallel axis (sp-sharded KV cache: context scales "
+             "with chips)",
+    )
     parser.add_argument("--dtype", type=str, default=None)
     parser.add_argument("--redis_host", default="localhost")
     parser.add_argument("--redis_port", type=int, default=6379)
@@ -236,7 +241,7 @@ def main(argv=None):
     from llmss_tpu.serve.broker import RedisBroker
 
     initialize_runtime()
-    mesh = make_mesh(MeshPlan(dp=args.dp, tp=args.tp))
+    mesh = make_mesh(MeshPlan(dp=args.dp, sp=args.sp, tp=args.tp))
     dtype = args.dtype or str(default_compute_dtype())
     cfg, params = load_model(args.pretrained_model_path, mesh, dtype=dtype)
     engine = DecodeEngine(

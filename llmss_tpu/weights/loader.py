@@ -33,7 +33,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from safetensors import safe_open
+from llmss_tpu.weights.native_st import NativeSafetensors
 
 
 class CheckpointShards:
@@ -42,6 +42,10 @@ class CheckpointShards:
     ``dtype`` is the target compute dtype for floating-point tensors;
     integer tensors (quantization scales/indices) are left untouched, like the
     reference's int32 gptq guard (``weights.py:90-93``).
+
+    Byte reads go through the native gather library
+    (``llmss_tpu/native/st_gather.cc`` via ``weights/native_st.py``):
+    GIL-free threaded pread, with whole layer-stacks batched into one call.
     """
 
     def __init__(
@@ -51,20 +55,21 @@ class CheckpointShards:
         aliases: dict[str, list[str]] | None = None,
     ):
         routing: dict[str, Path] = {}
+        self._handles: dict[Path, NativeSafetensors] = {}
         for filename in filenames:
             filename = Path(filename)
-            with safe_open(filename, framework="numpy") as f:
-                for k in f.keys():
-                    if k in routing:
-                        raise RuntimeError(
-                            f"Key {k} was found in multiple files: "
-                            f"{filename} and {routing[k]}"
-                        )
-                    routing[k] = filename
+            f = NativeSafetensors(filename)
+            self._handles[filename] = f
+            for k in f.keys():
+                if k in routing:
+                    raise RuntimeError(
+                        f"Key {k} was found in multiple files: "
+                        f"{filename} and {routing[k]}"
+                    )
+                routing[k] = filename
         self.routing = routing
         self.dtype = dtype
         self.aliases = aliases or {}
-        self._handles: dict[Path, object] = {}
 
     # -- resolution ---------------------------------------------------------
 
@@ -76,11 +81,8 @@ class CheckpointShards:
                 return alias
         raise KeyError(f"weight {name} not found (aliases tried)")
 
-    def _handle(self, name: str):
-        filename = self.routing[self._resolve(name)]
-        if filename not in self._handles:
-            self._handles[filename] = safe_open(filename, framework="numpy")
-        return self._handles[filename]
+    def _handle(self, name: str) -> NativeSafetensors:
+        return self._handles[self.routing[self._resolve(name)]]
 
     def __contains__(self, name: str) -> bool:
         try:
@@ -95,7 +97,7 @@ class CheckpointShards:
     # -- host-side reads ----------------------------------------------------
 
     def get_shape(self, name: str) -> tuple[int, ...]:
-        return tuple(self._handle(name).get_slice(self._resolve(name)).get_shape())
+        return tuple(self._handle(name).shape(self._resolve(name)))
 
     def _cast(self, x: np.ndarray) -> np.ndarray:
         if self.dtype is None:
@@ -108,7 +110,7 @@ class CheckpointShards:
         return x.astype(self.dtype) if is_float else x
 
     def get_tensor(self, name: str) -> np.ndarray:
-        x = self._handle(name).get_tensor(self._resolve(name))
+        x = self._handle(name).read(self._resolve(name))
         return self._cast(x)
 
     def read_slice(
@@ -132,20 +134,59 @@ class CheckpointShards:
         in memory, ``gpt_bigcode_modeling.py:120-155``; here only the
         addressed bytes are read).
         """
+        resolved, raw = self._raw_request(name, index, transpose, sub)
+        chunk = self._handle(name).read(resolved, raw)
+        if transpose:
+            chunk = chunk.T
+        return self._cast(chunk)
+
+    def _raw_request(
+        self,
+        name: str,
+        index: tuple[slice, ...],
+        transpose: bool,
+        sub: tuple[int, int, int] | None,
+    ) -> tuple[str, tuple[slice, ...]]:
+        """Map a logical (transposed/sub-shifted) index to the on-disk one."""
         if sub is not None:
             axis, start, _stop = sub
             ix = list(index)
             s = ix[axis]
-            ix[axis] = slice((s.start or 0) + start, s.stop + start if s.stop is not None else _stop)
+            ix[axis] = slice(
+                (s.start or 0) + start,
+                s.stop + start if s.stop is not None else _stop,
+            )
             index = tuple(ix)
-        sl = self._handle(name).get_slice(self._resolve(name))
         if transpose:
             index = tuple(reversed(index))
-            chunk = sl[index]
-            chunk = np.asarray(chunk).T
-        else:
-            chunk = np.asarray(sl[index])
-        return self._cast(chunk)
+        return self._resolve(name), index
+
+    def read_slices(
+        self,
+        names: Sequence[str],
+        index: tuple[slice, ...],
+        transpose: bool = False,
+        sub: tuple[int, int, int] | None = None,
+    ) -> list[np.ndarray]:
+        """Batched ``read_slice`` over many tensors: one native gather call
+        per file (the stacked per-layer loads fan every layer's shard over
+        the pread pool at once)."""
+        resolved = [
+            self._raw_request(n, index, transpose, sub) for n in names
+        ]
+        by_file: dict[Path, list[int]] = {}
+        for i, (rname, _) in enumerate(resolved):
+            by_file.setdefault(self.routing[rname], []).append(i)
+        chunks: list[np.ndarray | None] = [None] * len(names)
+        for filename, idxs in by_file.items():
+            outs = self._handles[filename].read_many(
+                [resolved[i] for i in idxs]
+            )
+            for i, out in zip(idxs, outs):
+                chunks[i] = out
+        return [
+            self._cast(c.T if transpose else c) for c in chunks
+        ]
 
     # -- device loads -------------------------------------------------------
 
@@ -212,12 +253,9 @@ class CheckpointShards:
             l_sl = index[0]
             lo = l_sl.start or 0
             hi = l_sl.stop if l_sl.stop is not None else len(names)
-            parts = [
-                self.read_slice(
-                    names[l], tuple(index[1:]), transpose=transpose, sub=sub
-                )
-                for l in range(lo, hi)
-            ]
+            parts = self.read_slices(
+                names[lo:hi], tuple(index[1:]), transpose=transpose, sub=sub
+            )
             return np.stack(parts, axis=0)
 
         return jax.make_array_from_callback(global_shape, sharding, callback)

@@ -1,0 +1,111 @@
+"""Native safetensors gather (C++ pread pool) vs the safetensors package.
+
+The data plane for weight loads is ``native/st_gather.cc`` (threaded strided
+pread through ctypes); these tests pin its reads — full, dim-0/dim-1 shard,
+2D rectangle, bf16, batched multi-tensor — against the safetensors package
+on the same file, plus the memmap fallback used when no toolchain exists.
+"""
+
+import numpy as np
+import pytest
+
+import ml_dtypes
+from safetensors.numpy import save_file
+
+from llmss_tpu.weights import native_st
+from llmss_tpu.weights.native_st import NativeSafetensors
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    rng = np.random.default_rng(0)
+    data = {
+        "w2d": rng.normal(size=(96, 56)).astype(np.float32),
+        "b1d": rng.normal(size=(41,)).astype(np.float32),
+        "wbf16": rng.normal(size=(32, 128)).astype(ml_dtypes.bfloat16),
+        "t3d": rng.normal(size=(3, 8, 16)).astype(np.float32),
+        "i32": rng.integers(0, 100, (24,)).astype(np.int32),
+    }
+    path = tmp_path_factory.mktemp("st") / "model.safetensors"
+    save_file(data, str(path))
+    return str(path), data
+
+
+def test_header_parse(ckpt):
+    path, data = ckpt
+    st = NativeSafetensors(path)
+    assert set(st.keys()) == set(data)
+    for k, v in data.items():
+        assert st.shape(k) == v.shape
+        assert st.dtype(k) == v.dtype
+
+
+@pytest.mark.parametrize(
+    "name,index",
+    [
+        ("w2d", None),
+        ("w2d", (slice(24, 72), slice(None))),  # dim-0 shard
+        ("w2d", (slice(None), slice(14, 42))),  # dim-1 shard (strided)
+        ("w2d", (slice(5, 91), slice(3, 9))),  # rectangle
+        ("b1d", (slice(7, 30),)),
+        ("wbf16", (slice(8, 24), slice(32, 96))),
+        ("t3d", None),
+        ("t3d", (slice(0, 2), slice(1, 5), slice(2, 9))),  # memmap path
+        ("i32", None),
+    ],
+)
+def test_reads_match(ckpt, name, index):
+    path, data = ckpt
+    st = NativeSafetensors(path)
+    expect = data[name][index] if index is not None else data[name]
+    np.testing.assert_array_equal(st.read(name, index), expect)
+
+
+def test_read_many_batched(ckpt):
+    path, data = ckpt
+    st = NativeSafetensors(path)
+    reqs = [
+        ("w2d", (slice(0, 48), slice(None))),
+        ("b1d", None),
+        ("t3d", (slice(1, 3), slice(None), slice(4, 12))),  # mixed fallback
+        ("wbf16", (slice(None), slice(0, 64))),
+    ]
+    outs = st.read_many(reqs)
+    np.testing.assert_array_equal(outs[0], data["w2d"][:48])
+    np.testing.assert_array_equal(outs[1], data["b1d"])
+    np.testing.assert_array_equal(outs[2], data["t3d"][1:3, :, 4:12])
+    np.testing.assert_array_equal(outs[3], data["wbf16"][:, :64])
+
+
+def test_memmap_fallback_matches(ckpt, monkeypatch):
+    path, data = ckpt
+    monkeypatch.setattr(native_st, "_build_lib", lambda: None)
+    st = NativeSafetensors(path)
+    np.testing.assert_array_equal(
+        st.read("w2d", (slice(None), slice(14, 42))), data["w2d"][:, 14:42]
+    )
+    np.testing.assert_array_equal(st.read("b1d"), data["b1d"])
+
+
+def test_checkpoint_shards_use_native(ckpt):
+    """CheckpointShards reads (incl. transpose + batched stacked loads)
+    produce identical bytes through the native path."""
+    from llmss_tpu.weights.loader import CheckpointShards
+
+    path, data = ckpt
+    ckpt_shards = CheckpointShards([path])
+    np.testing.assert_array_equal(
+        ckpt_shards.read_slice("w2d", (slice(10, 20), slice(0, 56))),
+        data["w2d"][10:20],
+    )
+    np.testing.assert_array_equal(
+        ckpt_shards.read_slice(
+            "w2d", (slice(0, 56), slice(10, 20)), transpose=True
+        ),
+        data["w2d"].T[:, 10:20],
+    )
+    outs = ckpt_shards.read_slices(
+        ["w2d", "w2d"], (slice(0, 8), slice(8, 16))
+    )
+    for out in outs:
+        np.testing.assert_array_equal(out, data["w2d"][:8, 8:16])
