@@ -126,6 +126,20 @@ class ContinuousBatcher:
             self._free.append(row)
         r.done_cb(r.out)
 
+    def drain_all(self) -> list[str]:
+        """Remove every pending and active request and return their ids —
+        supervisor teardown: a restarting worker must error these out so no
+        client waits forever on a request the new batcher never saw."""
+        with self._lock:
+            ids = [req_id for (req_id, *_rest) in self.pending]
+            self.pending.clear()
+        for row in list(self.active):
+            r = self.active.pop(row)
+            ids.append(r.req_id)
+            with self._lock:
+                self._free.append(row)
+        return ids
+
     def _sample_args_all(self):
         gens = []
         for i in range(self.rows):

@@ -78,6 +78,63 @@ def attention(
     return out.reshape(B, S, Hq, D).astype(q.dtype)
 
 
+def fresh_kv_decode_attention(
+    q: jax.Array,  # [B, 1, Hq, D]
+    k_cache: jax.Array,  # [B, T, Hkv, D] — stale (current token NOT written)
+    v_cache: jax.Array,
+    k_new: jax.Array,  # [B, 1, Hkv, D] — current token's KV
+    v_new: jax.Array,
+    q_pos: jax.Array,  # [B, 1]
+    kv_pos_old: jax.Array,  # [B, T] — pre-write slot positions
+    slots: jax.Array,  # [B, 1] — slot the current token will occupy
+    *,
+    scale: float | None = None,
+) -> jax.Array:
+    """Decode attention over a stale cache + the fresh current-token KV,
+    merged in one exact softmax.
+
+    This exists so the decode loop can defer all cache writes to a single
+    post-scan scatter: TPU scatter cost is per-op, and one scatter of
+    ``[L, B, 1, Hkv, D]`` is far cheaper than ``L`` per-layer scatters
+    inside the scan (~25% of decode step time at 1B scale). The slot the
+    current token will occupy is masked out of the cache read — on ring
+    wrap this also drops the overwritten token, exactly matching the
+    write-then-attend order of the in-scan path.
+    """
+    B, S, Hq, D = q.shape
+    T, Hkv = k_cache.shape[1], k_cache.shape[2]
+    G = Hq // Hkv
+    if scale is None:
+        scale = 1.0 / (D**0.5)
+
+    qf = q.astype(jnp.float32).reshape(B, S, Hkv, G, D) * scale
+    s_c = jnp.einsum("bskgd,btkd->bkgst", qf, k_cache.astype(jnp.float32))
+    slot_idx = jnp.arange(T, dtype=jnp.int32)
+    mask = (
+        (kv_pos_old[:, None, :] <= q_pos[:, :, None])
+        & (kv_pos_old[:, None, :] >= 0)
+        & (slot_idx[None, None, :] != slots[:, :, None])
+    )  # [B, S, T]
+    s_c = jnp.where(mask[:, None, None], s_c, _NEG_INF)
+    # Current token always attends itself (finite logit), so an empty cache
+    # degenerates cleanly to out = v_new.
+    s_s = jnp.einsum(
+        "bskgd,bskd->bkgs", qf, k_new.astype(jnp.float32)
+    )[..., None]  # [B, Hkv, G, S, 1]
+
+    m = jnp.maximum(jnp.max(s_c, axis=-1, keepdims=True), s_s)
+    p_c = jnp.exp(s_c - m)
+    p_s = jnp.exp(s_s - m)
+    denom = jnp.sum(p_c, axis=-1, keepdims=True) + p_s
+    out = (
+        jnp.einsum("bkgst,btkd->bkgsd", p_c, v_cache.astype(jnp.float32))
+        + p_s * v_new.astype(jnp.float32).transpose(0, 2, 1, 3)[:, :, None]
+    ) / denom
+    return (
+        out.transpose(0, 3, 1, 2, 4).reshape(B, S, Hq, D).astype(q.dtype)
+    )
+
+
 def dispatch_attention(
     q: jax.Array,  # [B, S, Hq, D]
     k: jax.Array,  # [B, T, Hkv, D]

@@ -30,7 +30,11 @@ def sample(
     """Sample next token ids [B] int32.
 
     Dynamic per-request top-k/top-p are implemented with one descending sort
-    (no static k), so a single compiled step serves any warper mix.
+    (no static k), so a single compiled step serves any warper mix — but the
+    sort is a real per-step cost at 32k+ vocab, so it is gated behind
+    runtime ``lax.cond``s: an all-greedy batch pays only the argmax, and a
+    warper-free sampled batch pays only the categorical draw. One compiled
+    program still serves every mix; the conditions are data, not shapes.
     """
     B, V = logits.shape
     greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -38,21 +42,35 @@ def sample(
     temp = jnp.maximum(temperature, 1e-6)[:, None]
     scaled = logits / temp
 
-    order = jnp.argsort(-scaled, axis=-1)
-    svals = jnp.take_along_axis(scaled, order, axis=-1)
-    probs = jax.nn.softmax(svals, axis=-1)
-    # Probability mass strictly before each sorted token: nucleus keeps the
-    # smallest prefix whose mass reaches top_p (always >= 1 token).
-    cum_before = jnp.cumsum(probs, axis=-1) - probs
-    rank = jnp.arange(V, dtype=jnp.int32)[None, :]
-    k_eff = jnp.where(top_k <= 0, V, top_k).astype(jnp.int32)[:, None]
-    keep = (rank < k_eff) & (cum_before < top_p[:, None])
-    keep = keep.at[:, 0].set(True)
-    filtered = jnp.where(keep, svals, float(jnp.finfo(jnp.float32).min))
+    def _filtered_sample() -> jax.Array:
+        order = jnp.argsort(-scaled, axis=-1)
+        svals = jnp.take_along_axis(scaled, order, axis=-1)
+        probs = jax.nn.softmax(svals, axis=-1)
+        # Probability mass strictly before each sorted token: nucleus keeps
+        # the smallest prefix whose mass reaches top_p (always >= 1 token).
+        cum_before = jnp.cumsum(probs, axis=-1) - probs
+        rank = jnp.arange(V, dtype=jnp.int32)[None, :]
+        k_eff = jnp.where(top_k <= 0, V, top_k).astype(jnp.int32)[:, None]
+        keep = (rank < k_eff) & (cum_before < top_p[:, None])
+        keep = keep.at[:, 0].set(True)
+        filtered = jnp.where(keep, svals, float(jnp.finfo(jnp.float32).min))
+        choice = jax.random.categorical(key, filtered, axis=-1)
+        return jnp.take_along_axis(
+            order, choice[:, None], axis=-1
+        )[:, 0].astype(jnp.int32)
 
-    choice = jax.random.categorical(key, filtered, axis=-1)
-    sampled_tok = jnp.take_along_axis(
-        order, choice[:, None], axis=-1
-    )[:, 0].astype(jnp.int32)
+    def _plain_sample() -> jax.Array:
+        # No top-k/top-p anywhere in the batch: categorical over the
+        # temperature-scaled logits needs no sort.
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
+    any_sampled = jnp.any(~greedy)
+    needs_filter = jnp.any(
+        (~greedy) & ((top_k > 0) | (top_p < 1.0))
+    )
+    sampled_tok = jax.lax.cond(
+        any_sampled,
+        lambda: jax.lax.cond(needs_filter, _filtered_sample, _plain_sample),
+        lambda: greedy_tok,
+    )
     return jnp.where(greedy, greedy_tok, sampled_tok)

@@ -36,7 +36,20 @@ class Broker(abc.ABC):
     # Workers publish their metrics snapshot through the broker so the
     # producer can serve GET /metrics even when producer and consumer are
     # separate processes (the reference has no metrics surface at all,
-    # SURVEY.md §5).
+    # SURVEY.md §5). ``metrics_extra`` (when set, e.g. by the Supervisor)
+    # is merged into EVERY publish — publishes are last-write-wins, so
+    # without the merge a worker-side publish would transiently erase the
+    # supervisor's health block from the channel.
+    metrics_extra = None  # optional () -> dict
+
+    def _merged(self, metrics: dict) -> dict:
+        if self.metrics_extra is not None:
+            try:
+                return {**metrics, **self.metrics_extra()}
+            except Exception:  # noqa: BLE001 — health hook must not break IO
+                return metrics
+        return metrics
+
     def publish_metrics(self, metrics: dict) -> None:  # noqa: B027
         pass
 
@@ -54,7 +67,7 @@ class InProcBroker(Broker):
         self._metrics: dict = {}
 
     def publish_metrics(self, metrics: dict) -> None:
-        self._metrics = metrics
+        self._metrics = self._merged(metrics)
 
     def read_metrics(self) -> dict:
         return self._metrics
@@ -130,7 +143,7 @@ class RedisBroker(Broker):
     def publish_metrics(self, metrics: dict) -> None:
         import json
 
-        self._r.set("llmss:metrics", json.dumps(metrics), ex=120)
+        self._r.set("llmss:metrics", json.dumps(self._merged(metrics)), ex=120)
 
     def read_metrics(self) -> dict:
         import json

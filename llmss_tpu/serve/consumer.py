@@ -203,6 +203,17 @@ class ContinuousWorker:
             self.broker.publish_metrics(self.engine.metrics.to_dict())
         return n
 
+    def abort_inflight(self, reason: str) -> int:
+        """Error out every admitted-but-unfinished request (supervisor
+        teardown contract: every request gets a response, even across a
+        worker restart)."""
+        ids = self.batcher.drain_all()
+        for rid in ids:
+            self.broker.push_response(
+                GenerateResponse(id=rid, error=f"worker restarted: {reason}")
+            )
+        return len(ids)
+
     def run_forever(self, stop: threading.Event | None = None) -> None:
         while stop is None or not stop.is_set():
             self.run_once()
@@ -230,6 +241,12 @@ def main(argv=None):
     parser.add_argument("--dtype", type=str, default=None)
     parser.add_argument("--redis_host", default="localhost")
     parser.add_argument("--redis_port", type=int, default=6379)
+    parser.add_argument(
+        "--supervise", action="store_true",
+        help="run under the crash-restart supervisor (heartbeats + capped "
+             "exponential backoff)",
+    )
+    parser.add_argument("--max_restarts", type=int, default=None)
     args = parser.parse_args(argv)
 
     from transformers import AutoTokenizer
@@ -250,14 +267,25 @@ def main(argv=None):
     )
     tokenizer = AutoTokenizer.from_pretrained(args.pretrained_model_path)
     broker = RedisBroker(args.redis_host, args.redis_port)
-    if args.continuous:
-        worker = ContinuousWorker(
-            engine, broker, tokenizer, rows=args.batch_size
-        )
+
+    def make_worker():
+        if args.continuous:
+            return ContinuousWorker(
+                engine, broker, tokenizer, rows=args.batch_size
+            )
+        return Worker(engine, broker, tokenizer, batch_size=args.batch_size)
+
+    print(
+        "consumer serving"
+        + (" (continuous batching)" if args.continuous else "")
+        + (" (supervised)" if args.supervise else "")
+    )
+    if args.supervise:
+        from llmss_tpu.serve.supervisor import Supervisor
+
+        Supervisor(make_worker, broker, max_restarts=args.max_restarts).run()
     else:
-        worker = Worker(engine, broker, tokenizer, batch_size=args.batch_size)
-    print("consumer serving" + (" (continuous batching)" if args.continuous else ""))
-    worker.run_forever()
+        make_worker().run_forever()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,139 @@
+"""Serving-loop supervision: crash containment, restart, liveness.
+
+The reference has no failure handling at all — a consumer crash kills the
+job and nothing notices (SURVEY.md §5 "Failure detection: absent", the only
+mitigations being NCCL's 60 s timeout, ``dist.py:54``, and hub download
+retries). Here the worker loop runs under a supervisor that:
+
+- owns the iteration loop (calls ``worker.run_once()``), so it can publish
+  a liveness heartbeat between iterations — the producer's ``/metrics``
+  exposes worker health, not just throughput;
+- contains crashes: an exception escaping an iteration tears down the
+  worker, publishes the failure, and rebuilds from the factory after a
+  capped exponential backoff (reset once the worker has been stable);
+- enforces an optional restart budget (``max_restarts``) so a
+  crash-looping model surfaces as a hard failure instead of burning a chip.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable
+
+logger = logging.getLogger("llmss_tpu.serve")
+
+
+class Supervisor:
+    def __init__(
+        self,
+        worker_factory: Callable[[], object],
+        broker,
+        *,
+        max_restarts: int | None = None,
+        backoff_s: float = 1.0,
+        backoff_cap_s: float = 60.0,
+        stable_after_s: float = 120.0,
+        heartbeat_s: float = 5.0,
+    ):
+        self.worker_factory = worker_factory
+        self.broker = broker
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.backoff_cap_s = backoff_cap_s
+        self.stable_after_s = stable_after_s
+        self.heartbeat_s = heartbeat_s
+        self.restarts = 0
+        self.alive = False
+        self._last_error: str | None = None
+        self._start = time.time()
+        # Merged into EVERY broker publish (worker-side ones included), so
+        # the health block can never be erased by a last-write-wins publish.
+        broker.metrics_extra = lambda: {"supervisor": self._status()}
+
+    # -- status --------------------------------------------------------------
+
+    def _status(self) -> dict:
+        return {
+            "alive": self.alive,
+            "restarts": self.restarts,
+            "last_error": self._last_error,
+            "uptime_s": round(time.time() - self._start, 1),
+            "heartbeat_ts": round(time.time(), 3),
+        }
+
+    def _publish(self, worker) -> None:
+        metrics = {}
+        engine = getattr(worker, "engine", None)
+        if engine is not None:
+            metrics = engine.metrics.to_dict()
+        try:
+            self.broker.publish_metrics(metrics)
+        except Exception:  # noqa: BLE001 — broker down ≠ worker down
+            logger.warning("metrics publish failed", exc_info=True)
+
+    def _abort_inflight(self, worker, reason: str) -> None:
+        """Error out every request the dying worker still holds — a client
+        must always get a response, even across a restart."""
+        abort = getattr(worker, "abort_inflight", None)
+        if abort is None:
+            return
+        try:
+            n = abort(reason)
+            if n:
+                logger.warning("aborted %d in-flight requests", n)
+        except Exception:  # noqa: BLE001 — teardown must not mask the crash
+            logger.warning("in-flight abort failed", exc_info=True)
+
+    # -- loop ----------------------------------------------------------------
+
+    def run(self, stop: threading.Event | None = None) -> None:
+        """Supervised serving loop; returns when ``stop`` is set, raises
+        only when the restart budget is exhausted."""
+        backoff = self.backoff_s
+        while stop is None or not stop.is_set():
+            worker = None
+            started = time.time()
+            last_beat = 0.0
+            try:
+                # Factory inside the try: a rebuild failure is a crash too
+                # (backoff + budget apply), not a supervisor death.
+                worker = self.worker_factory()
+                self.alive = True
+                while stop is None or not stop.is_set():
+                    worker.run_once()
+                    now = time.time()
+                    if now - last_beat >= self.heartbeat_s:
+                        self._publish(worker)
+                        last_beat = now
+                    if now - started > self.stable_after_s:
+                        backoff = self.backoff_s
+            except Exception as e:  # noqa: BLE001 — crash containment
+                self.alive = False
+                self.restarts += 1
+                self._last_error = f"{type(e).__name__}: {e}"
+                logger.error(
+                    "worker crashed (%s), restart %d in %.1fs",
+                    self._last_error, self.restarts, backoff, exc_info=True,
+                )
+                if worker is not None:
+                    self._abort_inflight(worker, self._last_error)
+                self._publish(worker)
+                if (
+                    self.max_restarts is not None
+                    and self.restarts > self.max_restarts
+                ):
+                    raise RuntimeError(
+                        f"worker exceeded restart budget "
+                        f"({self.max_restarts}); last error: "
+                        f"{self._last_error}"
+                    ) from e
+                if stop is not None:
+                    if stop.wait(backoff):
+                        return
+                else:
+                    time.sleep(backoff)
+                backoff = min(backoff * 2, self.backoff_cap_s)
+                continue
+            return  # stop was set inside the inner loop

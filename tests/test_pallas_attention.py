@@ -119,6 +119,49 @@ def test_sharded_dispatch_matches_xla(devices):
     np.testing.assert_allclose(out, ref, atol=2e-2)
 
 
+def test_fresh_kv_decode_matches_write_then_attend():
+    """Deferred-write decode attention == scatter-then-attend, including
+    ring-wrap slot reuse and empty caches."""
+    from llmss_tpu.engine.cache import write_layer
+    from llmss_tpu.ops.attention import fresh_kv_decode_attention
+
+    rng = np.random.default_rng(11)
+    B, T, Hq, Hkv, D = 3, 32, 8, 4, 16
+    kc = _rand(rng, B, T, Hkv, D)
+    vc = _rand(rng, B, T, Hkv, D)
+    q = _rand(rng, B, 1, Hq, D)
+    k_new, v_new = _rand(rng, B, 1, Hkv, D), _rand(rng, B, 1, Hkv, D)
+    for case, (pos_list, qp_list) in {
+        "mid": ([12, 20, 0], [12, 20, 0]),  # row 2: empty cache
+        "wrap": ([40, 33, 63], [40, 33, 63]),  # past T: slot reuse
+    }.items():
+        kv_pos = np.full((B, T), -1, np.int32)
+        for b, p in enumerate(pos_list):
+            n = min(p, T)
+            # slots of the last n tokens before position p
+            for j in range(n):
+                pj = p - 1 - j
+                kv_pos[b, pj % T] = pj
+        q_pos = jnp.asarray(np.asarray(qp_list, np.int32)[:, None])
+        slots = q_pos % T
+        kv_pos = jnp.asarray(kv_pos)
+
+        out = fresh_kv_decode_attention(
+            q, kc, vc, k_new, v_new, q_pos, kv_pos, slots
+        )
+
+        kc2, vc2 = write_layer(kc, vc, k_new, v_new, slots)
+        b_idx = np.arange(B)[:, None]
+        kv_pos2 = jnp.asarray(np.asarray(kv_pos).copy())
+        kv_pos2 = kv_pos2.at[b_idx, np.asarray(slots)].set(
+            np.asarray(q_pos)
+        )
+        ref = attention(
+            q, kc2, vc2, make_causal_mask(q_pos, kv_pos2, kv_pos2 >= 0)
+        )
+        np.testing.assert_allclose(out, ref, atol=2e-2, err_msg=case)
+
+
 def test_gqa_replicated_kv_falls_back(devices):
     """Hkv=2 with tp=4 can't shard KV heads; the replicated-KV kernel path is
     only valid for MQA, so dispatch must fall back to XLA and stay correct

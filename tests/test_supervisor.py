@@ -1,0 +1,158 @@
+"""Supervisor: crash containment, restart backoff, liveness heartbeats.
+
+The reference's failure story is "the process dies" (SURVEY.md §5); these
+tests pin the supervised loop's contract: crashes rebuild the worker and
+service continues, the restart budget turns a crash loop into a hard error,
+and health is visible through the broker metrics channel.
+"""
+
+import threading
+
+import pytest
+
+from llmss_tpu.serve.broker import InProcBroker
+from llmss_tpu.serve.supervisor import Supervisor
+
+
+class FlakyWorker:
+    """Crashes on iterations listed in ``crash_at`` (global call count)."""
+
+    calls = 0
+
+    def __init__(self, crash_at, record):
+        self.crash_at = crash_at
+        self.record = record
+        self.record.append("built")
+
+    def run_once(self):
+        FlakyWorker.calls += 1
+        if FlakyWorker.calls in self.crash_at:
+            raise RuntimeError(f"boom@{FlakyWorker.calls}")
+        self.record.append(FlakyWorker.calls)
+
+
+@pytest.fixture(autouse=True)
+def _reset_calls():
+    FlakyWorker.calls = 0
+
+
+def _run_until(sup, stop_after_calls, record):
+    stop = threading.Event()
+
+    orig = FlakyWorker.run_once
+
+    def wrapped(self):
+        if FlakyWorker.calls >= stop_after_calls:
+            stop.set()
+            return
+        orig(self)
+
+    FlakyWorker.run_once = wrapped
+    try:
+        sup.run(stop)
+    finally:
+        FlakyWorker.run_once = orig
+
+
+def test_restarts_after_crash():
+    broker = InProcBroker()
+    record = []
+    sup = Supervisor(
+        lambda: FlakyWorker({3, 7}, record), broker,
+        backoff_s=0.01, heartbeat_s=0.0,
+    )
+    _run_until(sup, 12, record)
+    assert sup.restarts == 2
+    assert record.count("built") == 3  # initial + one per crash
+    assert "boom@3" in sup._last_error or "boom@7" in sup._last_error
+    m = broker.read_metrics()
+    assert m["supervisor"]["restarts"] == 2
+    assert m["supervisor"]["alive"] is True  # heartbeat after recovery
+
+
+def test_restart_budget_exhausted():
+    broker = InProcBroker()
+    record = []
+    sup = Supervisor(
+        lambda: FlakyWorker(set(range(1, 100)), record), broker,
+        backoff_s=0.0, max_restarts=3, heartbeat_s=0.0,
+    )
+    with pytest.raises(RuntimeError, match="restart budget"):
+        sup.run()
+    assert sup.restarts == 4
+    assert broker.read_metrics()["supervisor"]["alive"] is False
+
+
+def test_abort_inflight_errors_pending_requests(devices):
+    """A crashing continuous worker must error out admitted requests so no
+    client waits forever (supervisor teardown contract)."""
+    import jax
+
+    from llmss_tpu.engine import DecodeEngine
+    from llmss_tpu.models.common import DecoderConfig
+    from llmss_tpu.models.decoder import init_params
+    from llmss_tpu.parallel import MeshPlan, make_mesh
+    from llmss_tpu.serve.consumer import ContinuousWorker
+    from llmss_tpu.serve.protocol import GenerateRequest
+
+    mesh = make_mesh(MeshPlan(dp=1, sp=1, tp=8))
+    cfg = DecoderConfig(
+        model_type="llama", vocab_size=128, hidden_size=32, n_layers=1,
+        n_heads=4, n_kv_heads=4, head_dim=8, intermediate_size=64,
+        max_position_embeddings=64, activation="silu", norm="rmsnorm",
+        norm_eps=1e-5, mlp="swiglu", positions="rotary", rope_style="half",
+        rotary_dim=8, attn_bias=False, mlp_bias=False,
+        tie_word_embeddings=False, dtype="float32",
+    )
+    params = init_params(cfg, mesh, jax.random.key(0))
+    engine = DecodeEngine(cfg, params, mesh, max_seq_len=32)
+    broker = InProcBroker()
+    worker = ContinuousWorker(engine, broker, tokenizer=None, rows=2)
+    broker.push_request(GenerateRequest(
+        id="rq-long", token_ids=[1, 2, 3], max_new_tokens=500,
+        is_greedy=True,
+    ))
+    worker.run_once()  # admits the request; far from finished
+    n = worker.abort_inflight("boom")
+    assert n == 1
+    resp = broker.wait_response("rq-long", timeout=5)
+    assert resp is not None and "worker restarted: boom" in resp.error
+
+
+def test_supervisor_status_survives_worker_publish():
+    """Worker-side publish_metrics must not erase the supervisor block."""
+    broker = InProcBroker()
+    sup = Supervisor(lambda: None, broker, heartbeat_s=0.0)
+    broker.publish_metrics({"tokens_generated": 5})  # worker-style publish
+    m = broker.read_metrics()
+    assert m["tokens_generated"] == 5
+    assert m["supervisor"]["restarts"] == sup.restarts == 0
+
+
+def test_factory_failure_is_contained():
+    """A worker_factory exception counts as a crash (budget applies), it
+    does not kill the supervisor outright."""
+    broker = InProcBroker()
+
+    def bad_factory():
+        raise OSError("cannot rebuild")
+
+    sup = Supervisor(
+        bad_factory, broker, backoff_s=0.0, max_restarts=2, heartbeat_s=0.0
+    )
+    with pytest.raises(RuntimeError, match="restart budget"):
+        sup.run()
+    assert sup.restarts == 3
+    assert broker.read_metrics()["supervisor"]["alive"] is False
+
+
+def test_clean_stop():
+    broker = InProcBroker()
+    record = []
+    sup = Supervisor(
+        lambda: FlakyWorker(set(), record), broker,
+        backoff_s=0.01, heartbeat_s=0.0,
+    )
+    _run_until(sup, 5, record)
+    assert sup.restarts == 0
+    assert broker.read_metrics()["supervisor"]["alive"] is True
