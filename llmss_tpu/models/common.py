@@ -51,6 +51,12 @@ class DecoderConfig:
     rotary_dim: int | None = None  # partial rotary (config.rotary_dim, GPT-J)
     rope_theta: float = 10000.0
 
+    # Sliding-window attention (Mistral): each token attends only the last
+    # ``sliding_window`` positions. None = full causal. The ring-buffer
+    # cache (engine/cache.py) makes this natural: a cache of window size
+    # wraps and the mask drops the overwritten tail.
+    sliding_window: int | None = None
+
     attn_bias: bool = True
     mlp_bias: bool = True
     head_bias: bool = False

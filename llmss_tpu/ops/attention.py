@@ -37,17 +37,22 @@ def make_causal_mask(
     q_positions: jax.Array,  # [B, S] int — absolute position of each query
     kv_positions: jax.Array,  # [B, T] int — absolute position of each cache slot
     kv_valid: jax.Array,  # [B, T] bool — slot holds a real token
+    window: int | None = None,  # sliding-window width (Mistral); None = full
 ) -> jax.Array:
-    """Boolean [B, S, T] mask: query may attend to valid slots at <= position.
+    """Boolean [B, S, T] mask: query may attend to valid slots at <= position
+    (and within the sliding window, when set).
 
     Replaces the reference's precomputed tril buffer
     (``gptj_modeling.py:55-61``) with position arithmetic that works for both
     contiguous prefill and ring-buffer decode, where cache slot order is not
     position order.
     """
-    return (kv_positions[:, None, :] <= q_positions[:, :, None]) & kv_valid[
+    mask = (kv_positions[:, None, :] <= q_positions[:, :, None]) & kv_valid[
         :, None, :
     ]
+    if window is not None:
+        mask &= kv_positions[:, None, :] > q_positions[:, :, None] - window
+    return mask
 
 
 def attention(
@@ -89,6 +94,7 @@ def fresh_kv_decode_attention(
     slots: jax.Array,  # [B, 1] — slot the current token will occupy
     *,
     scale: float | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Decode attention over a stale cache + the fresh current-token KV,
     merged in one exact softmax.
@@ -115,6 +121,8 @@ def fresh_kv_decode_attention(
         & (kv_pos_old[:, None, :] >= 0)
         & (slot_idx[None, None, :] != slots[:, :, None])
     )  # [B, S, T]
+    if window is not None:
+        mask &= kv_pos_old[:, None, :] > q_pos[:, :, None] - window
     s_c = jnp.where(mask[:, None, None], s_c, _NEG_INF)
     # Current token always attends itself (finite logit), so an empty cache
     # degenerates cleanly to out = v_new.
@@ -145,6 +153,7 @@ def dispatch_attention(
     kv_positions: jax.Array,  # [B, T] (pallas path)
     scale: float | None = None,
     mesh=None,
+    window: int | None = None,  # sliding-window width (None = full causal)
 ) -> jax.Array:
     """Route to the right implementation:
 
@@ -198,7 +207,8 @@ def dispatch_attention(
             ks = P(AXIS_DP, AXIS_SP, kv_ax, None)
 
             def local_sp(q, k, v, qp, kvp):
-                return fn(q, k, v, qp, kvp, axis_name=AXIS_SP, scale=scale)
+                return fn(q, k, v, qp, kvp, axis_name=AXIS_SP, scale=scale,
+                          window=window)
 
             return jax.shard_map(
                 local_sp, mesh=mesh,
@@ -222,7 +232,8 @@ def dispatch_attention(
 
             def local(q, k, v, qp, kvp):
                 return pallas_attention.flash_attention(
-                    q, k, v, qp, kvp, scale=scale, interpret=interp
+                    q, k, v, qp, kvp, scale=scale, window=window,
+                    interpret=interp,
                 )
 
             return jax.shard_map(

@@ -54,6 +54,7 @@ def _kernel(
     acc_ref,  # [bq, D] f32 scratch — running weighted values
     *,
     scale: float,
+    window: int | None,
 ):
     j = pl.program_id(3)
     n_j = pl.num_programs(3)
@@ -68,8 +69,12 @@ def _kernel(
     kvp = kvp_ref[0, 0, :]  # [bk]
 
     # Block skip: every contribution is masked iff no slot is both valid and
-    # causally visible to the *latest* query in the block.
-    live = jnp.any((kvp >= 0) & (kvp <= jnp.max(qp)))
+    # causally visible to the *latest* query in the block (and, with a
+    # sliding window, not entirely behind the *earliest* query's window).
+    live = (kvp >= 0) & (kvp <= jnp.max(qp))
+    if window is not None:
+        live &= kvp > jnp.min(qp) - window
+    live = jnp.any(live)
 
     @pl.when(live)
     def _accumulate():
@@ -82,6 +87,8 @@ def _kernel(
             preferred_element_type=jnp.float32,
         ) * scale  # [bq, bk] f32
         mask = (kvp[None, :] <= qp[:, None]) & (kvp[None, :] >= 0)
+        if window is not None:
+            mask &= kvp[None, :] > qp[:, None] - window
         s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[:, :1]  # [bq, 1]
@@ -115,7 +122,8 @@ def supports(S: int, T: int, Hq: int, Hkv: int, *, min_q: int = 16) -> bool:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "block_q", "block_k", "interpret")
+    jax.jit,
+    static_argnames=("scale", "block_q", "block_k", "window", "interpret"),
 )
 def flash_attention(
     q: jax.Array,  # [B, S, Hq, D]
@@ -127,6 +135,7 @@ def flash_attention(
     scale: float | None = None,
     block_q: int = 1024,
     block_k: int = 512,
+    window: int | None = None,  # sliding-window width (None = full causal)
     interpret: bool = False,
 ) -> jax.Array:
     """Blockwise flash attention; same contract as ``ops.attention.attention``
@@ -155,7 +164,7 @@ def flash_attention(
     grid = (B, Hq, S // bq, T // bk)
 
     out = pl.pallas_call(
-        functools.partial(_kernel, scale=float(scale)),
+        functools.partial(_kernel, scale=float(scale), window=window),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, 0, i)),

@@ -36,7 +36,7 @@ from jax import lax
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
-def _online_block(m, l, acc, q, k, v, q_pos, kv_pos, scale):
+def _online_block(m, l, acc, q, k, v, q_pos, kv_pos, scale, window=None):
     """One online-softmax accumulation step of grouped-query attention.
 
     State shapes: m/l [B, Hkv, G, S], acc [B, Hkv, G, S, D] (fp32).
@@ -50,6 +50,8 @@ def _online_block(m, l, acc, q, k, v, q_pos, kv_pos, scale):
     mask = (kv_pos[:, None, :] <= q_pos[:, :, None]) & (
         kv_pos[:, None, :] >= 0
     )  # [B, S, C]
+    if window is not None:
+        mask &= kv_pos[:, None, :] > q_pos[:, :, None] - window
     s = jnp.where(mask[:, None, None], s, _NEG_INF)
 
     m_cur = jnp.max(s, axis=-1)
@@ -95,6 +97,7 @@ def ring_attention(
     *,
     axis_name: str,
     scale: float | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Sequence-parallel prefill attention. Must run inside ``shard_map``
     with ``axis_name`` mapped; returns the local [B, S_local, Hq, D] shard."""
@@ -105,7 +108,9 @@ def ring_attention(
     m, l, acc = _init_state(q, k.shape[2])
     perm = [(i, (i + 1) % sp) for i in range(sp)]
     for step in range(sp):
-        m, l, acc = _online_block(m, l, acc, q, k, v, q_pos, kv_pos, scale)
+        m, l, acc = _online_block(
+            m, l, acc, q, k, v, q_pos, kv_pos, scale, window
+        )
         if step < sp - 1:
             # Rotate the KV chunk one hop; position metadata travels with it
             # so masking stays exact for any slot/position layout.
@@ -124,6 +129,7 @@ def lse_merge_attention(
     *,
     axis_name: str,
     scale: float | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Split-KV decode attention over the ``sp`` axis: local partial softmax
     + one log-sum-exp-weighted psum merge. Returns replicated output."""
@@ -131,7 +137,9 @@ def lse_merge_attention(
     if scale is None:
         scale = 1.0 / (D**0.5)
     m0, l0, acc0 = _init_state(q, k.shape[2])
-    m, l, acc = _online_block(m0, l0, acc0, q, k, v, q_pos, kv_pos, scale)
+    m, l, acc = _online_block(
+        m0, l0, acc0, q, k, v, q_pos, kv_pos, scale, window
+    )
     m_g = lax.pmax(m, axis_name)
     w = jnp.exp(m - m_g)  # all-masked chunk: exp(min - real) == 0, drops out
     l_g = lax.psum(l * w, axis_name)
