@@ -96,9 +96,8 @@ def main():
     ids, lens = engine._pad_prompts(prompts)
     sa = engine._sample_args(gen, BATCH)
     t0 = time.perf_counter()
-    tok, _, cache, _ = engine._prefill(
+    tok, _, cache = engine._prefill(
         engine.params, jnp.asarray(ids), cache, jnp.asarray(lens), sa,
-        jax.random.key(1),
     )
     tok.block_until_ready()
     ttft_ms = (time.perf_counter() - t0) * 1e3

@@ -51,12 +51,18 @@ class GenerationParams:
     seed: int = 0
 
     def validate(self) -> None:
-        # Range asserts, parity with generate.py:37-40.
+        # Range checks, parity with generate.py:37-40 — but raising, not
+        # asserting: the engine path must reject bad params under
+        # ``python -O`` too, same as the protocol path.
         if not self.is_greedy:
-            assert self.temperature > 0.0, "temperature must be > 0"
-            assert self.top_k >= 0, "top_k must be >= 0"
-            assert 0.0 < self.top_p <= 1.0, "top_p must be in (0, 1]"
-        assert self.max_new_tokens > 0
+            if not self.temperature > 0.0:
+                raise ValueError("temperature must be > 0")
+            if not self.top_k >= 0:
+                raise ValueError("top_k must be >= 0")
+            if not 0.0 < self.top_p <= 1.0:
+                raise ValueError("top_p must be in (0, 1]")
+        if not self.max_new_tokens > 0:
+            raise ValueError("max_new_tokens must be > 0")
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -105,8 +111,7 @@ class DecodeEngine:
     # -- jitted bodies ------------------------------------------------------
 
     @staticmethod
-    def _prefill_impl(cfg, mesh, params, ids, cache, prompt_lens, sample_args,
-                      key):
+    def _prefill_impl(cfg, mesh, params, ids, cache, prompt_lens, sample_args):
         B, S = ids.shape
         positions = jnp.broadcast_to(
             jnp.arange(S, dtype=jnp.int32), (B, S)
@@ -118,13 +123,14 @@ class DecodeEngine:
             cfg, params, ids, positions, cache, slots,
             gather_idx=prompt_lens - 1, kv_write_positions=kv_pos, mesh=mesh,
         )
-        key, sub = jax.random.split(key)
-        tok = sample(logits[:, 0], sub, **sample_args)
-        return tok, logits[:, 0], cache, key
+        # The sampled token sits at position prompt_len — that position is
+        # the per-row draw counter (ops/sampling.py: stateless per-request
+        # randomness).
+        tok = sample(logits[:, 0], counters=prompt_lens, **sample_args)
+        return tok, logits[:, 0], cache
 
     @staticmethod
-    def _decode_impl(cfg, mesh, params, tokens, cache, cur_pos, sample_args,
-                     key):
+    def _decode_impl(cfg, mesh, params, tokens, cache, cur_pos, sample_args):
         # tokens [B], cur_pos [B] — position at which each token sits.
         positions = cur_pos[:, None]
         slots = positions % cache.max_len
@@ -132,37 +138,35 @@ class DecodeEngine:
             cfg, params, tokens[:, None], positions, cache, slots,
             last_only=True, mesh=mesh,
         )
-        key, sub = jax.random.split(key)
-        tok = sample(logits[:, 0], sub, **sample_args)
-        return tok, logits[:, 0], cache, key
+        tok = sample(logits[:, 0], counters=cur_pos + 1, **sample_args)
+        return tok, logits[:, 0], cache
 
     @staticmethod
     def _decode_many_impl(
-        cfg, mesh, params, tokens, cache, cur_pos, sample_args, key, done,
+        cfg, mesh, params, tokens, cache, cur_pos, sample_args, done,
         eos, *, n_steps: int,
     ):
         """Fused multi-token decode: lax.scan over the single-token step."""
 
         def body(carry, _):
-            tokens, cache, cur_pos, key, done = carry
+            tokens, cache, cur_pos, done = carry
             positions = cur_pos[:, None]
             slots = positions % cache.max_len
             logits, cache = forward(
                 cfg, params, tokens[:, None], positions, cache, slots,
                 last_only=True, mesh=mesh,
             )
-            key, sub = jax.random.split(key)
-            tok = sample(logits[:, 0], sub, **sample_args)
+            tok = sample(logits[:, 0], counters=cur_pos + 1, **sample_args)
             tok = jnp.where(done, eos, tok)
             done = done | (tok == eos)
             cur_pos = cur_pos + 1
-            return (tok, cache, cur_pos, key, done), tok
+            return (tok, cache, cur_pos, done), tok
 
         carry, toks = jax.lax.scan(
-            body, (tokens, cache, cur_pos, key, done), None, length=n_steps
+            body, (tokens, cache, cur_pos, done), None, length=n_steps
         )
-        tokens, cache, cur_pos, key, done = carry
-        return toks.T, cache, cur_pos, key, done  # toks [B, n_steps]
+        tokens, cache, cur_pos, done = carry
+        return toks.T, cache, cur_pos, done  # toks [B, n_steps]
 
     # -- host API -----------------------------------------------------------
 
@@ -194,6 +198,7 @@ class DecodeEngine:
         if isinstance(gens, GenerationParams):
             gens = [gens] * batch
         return dict(
+            seeds=jnp.asarray([g.seed for g in gens], jnp.int32),
             temperature=jnp.asarray(
                 [g.temperature for g in gens], jnp.float32
             ),
@@ -223,6 +228,7 @@ class DecodeEngine:
         gen: GenerationParams | list[GenerationParams],
         *,
         on_token=None,
+        cancel_poll=None,
     ) -> list[list[int]]:
         """Streaming host-loop generation (≙ generate.py:99-145 cache path).
 
@@ -231,6 +237,10 @@ class DecodeEngine:
         (the serving path; the reference hard-codes one config per batch).
         ``on_token(step, tokens: np.ndarray)`` is called per step — the
         serving layer streams from here. Stops early when every row is done.
+        ``cancel_poll() -> iterable[int]`` (optional) is polled each step for
+        row indices whose clients went away: those rows stop accumulating
+        tokens and count as done (so an all-cancelled batch stops decoding
+        within one step).
         """
         B = len(prompts)
         gens = gen if isinstance(gen, list) else [gen] * B
@@ -240,11 +250,10 @@ class DecodeEngine:
         ids, lens = self._pad_prompts(prompts)
         cache = self.new_cache(B)
         sample_args = self._sample_args(gens, B)
-        key = jax.random.key(gens[0].seed)
 
-        tok, _, cache, key = self.timed_prefill(
+        tok, _, cache = self.timed_prefill(
             self._prefill, self.params, jnp.asarray(ids), cache,
-            jnp.asarray(lens), sample_args, key, batch=B,
+            jnp.asarray(lens), sample_args, batch=B,
         )
         eos = np.asarray(
             [g.eos_token_id if g.eos_token_id is not None else -1
@@ -257,6 +266,9 @@ class DecodeEngine:
         total_steps = int(max_new.max())
 
         for step in range(total_steps):
+            if cancel_poll is not None:
+                for i in cancel_poll():
+                    done[i] = True
             tok_np = np.asarray(tok)
             newly_done = (tok_np == eos) | (step >= max_new)
             for i in range(B):
@@ -270,8 +282,8 @@ class DecodeEngine:
             if done.all() or step == total_steps - 1:
                 break
             with self.metrics.decode_step.time():
-                tok, _, cache, key = self._decode(
-                    self.params, tok, cache, cur_pos, sample_args, key
+                tok, _, cache = self._decode(
+                    self.params, tok, cache, cur_pos, sample_args
                 )
                 # Sync inside the timer: dispatch is async, so without this
                 # the stat would record ~µs dispatch overhead, not step
@@ -295,18 +307,17 @@ class DecodeEngine:
         ids, lens = self._pad_prompts(prompts)
         cache = self.new_cache(B)
         sample_args = self._sample_args(gen, B)
-        key = jax.random.key(gen.seed)
 
-        tok, _, cache, key = self.timed_prefill(
+        tok, _, cache = self.timed_prefill(
             self._prefill, self.params, jnp.asarray(ids), cache,
-            jnp.asarray(lens), sample_args, key, batch=B,
+            jnp.asarray(lens), sample_args, batch=B,
         )
         eos = jnp.int32(
             gen.eos_token_id if gen.eos_token_id is not None else -1
         )
         done = tok == eos
-        toks, cache, _, _, done = self._decode_many(
-            self.params, tok, cache, jnp.asarray(lens), sample_args, key,
+        toks, cache, _, done = self._decode_many(
+            self.params, tok, cache, jnp.asarray(lens), sample_args,
             done, eos, n_steps=gen.max_new_tokens - 1,
         )
         first = np.asarray(tok)[:, None]

@@ -52,8 +52,8 @@ class ContinuousBatcher:
         self.active: dict[int, _Row] = {}
         self._free = list(range(rows))
         self._tokens = np.zeros(rows, np.int32)
-        self._key = jax.random.key(0)
         self._step_count = 0
+        self._cancelled: set[str] = set()
         self._lock = threading.Lock()
 
         cfg = engine.cfg
@@ -98,10 +98,9 @@ class ContinuousBatcher:
         padded[0, : len(ids)] = ids
         scratch = self.engine.new_cache(1)
         sample_args = self.engine._sample_args(gen, 1)
-        self._key, sub = jax.random.split(self._key)
-        tok, _, scratch, _ = self.engine.timed_prefill(
+        tok, _, scratch = self.engine.timed_prefill(
             self._prefill_row, self.engine.params, jnp.asarray(padded),
-            scratch, jnp.asarray([len(ids)], jnp.int32), sample_args, sub,
+            scratch, jnp.asarray([len(ids)], jnp.int32), sample_args,
             batch=1,
         )
         self.cache = self._insert(self.cache, scratch, jnp.int32(row))
@@ -126,10 +125,41 @@ class ContinuousBatcher:
             self._free.append(row)
         r.done_cb(r.out)
 
+    def cancel(self, req_id: str) -> None:
+        """Mark a request cancelled (thread-safe). The worker thread frees
+        its row / drops it from the queue at the top of the next ``step()``
+        — i.e. a cancelled request stops consuming decode steps within one
+        step. Its ``done_cb`` fires with the tokens produced so far."""
+        with self._lock:
+            self._cancelled.add(req_id)
+
+    def _process_cancellations(self) -> int:
+        """Worker-thread half of ``cancel``: drop marked pending requests
+        and free marked active rows."""
+        with self._lock:
+            if not self._cancelled:
+                return 0
+            ids, self._cancelled = self._cancelled, set()
+            kept = deque(p for p in self.pending if p[0] not in ids)
+            n = len(self.pending) - len(kept)
+            self.pending = kept
+        for row, r in list(self.active.items()):
+            if r.req_id in ids:
+                self._finish(row, r)
+                n += 1
+        if n:
+            self.engine.metrics.add_cancelled(n)
+        return n
+
     def drain_all(self) -> list[str]:
         """Remove every pending and active request and return their ids —
         supervisor teardown: a restarting worker must error these out so no
-        client waits forever on a request the new batcher never saw."""
+        client waits forever on a request the new batcher never saw.
+
+        Runs on the worker thread (the supervisor tears down from inside the
+        crashed worker's loop), so touching ``self.active`` here doesn't race
+        ``step()``; the queue and free-list stay lock-guarded.
+        """
         with self._lock:
             ids = [req_id for (req_id, *_rest) in self.pending]
             self.pending.clear()
@@ -149,6 +179,7 @@ class ContinuousBatcher:
 
     def step(self) -> int:
         """Admit waiting requests, then advance all active rows one token."""
+        self._process_cancellations()
         while self._admit_one():
             pass
         if not self.active:
@@ -157,11 +188,10 @@ class ContinuousBatcher:
         cur_pos = np.zeros(self.rows, np.int32)
         for i, r in self.active.items():
             cur_pos[i] = r.cur_pos
-        self._key, sub = jax.random.split(self._key)
         with self.engine.metrics.decode_step.time():
-            tok, _, self.cache, _ = self.engine._decode(
+            tok, _, self.cache = self.engine._decode(
                 self.engine.params, jnp.asarray(self._tokens), self.cache,
-                jnp.asarray(cur_pos), self._sample_args_all(), sub,
+                jnp.asarray(cur_pos), self._sample_args_all(),
             )
             tok_np = np.asarray(tok)
 

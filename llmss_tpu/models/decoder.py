@@ -36,7 +36,9 @@ from llmss_tpu.ops.attention import (
     fresh_kv_decode_attention,
     make_causal_mask,
 )
-from llmss_tpu.ops.layers import LinearParams, NormParams, dense, embedding
+from llmss_tpu.ops.layers import (
+    LinearParams, NormParams, dense, dense_t, embedding,
+)
 from llmss_tpu.ops.rope import apply_rope
 from llmss_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
 from llmss_tpu.parallel.sharding import constrain
@@ -76,12 +78,16 @@ def param_specs(cfg: DecoderConfig, tp: int) -> Params:
 
     blocks: Params = {
         "ln1": _norm_specs(True, norm_bias),
+        # q/k weights are stored transposed — [L, out, in] — so the scan's
+        # per-layer slice feeds the rope-fused matmul without a relayout
+        # copy (see ops/layers.py:dense_t). Sharding stays Megatron
+        # column-parallel: the out axis carries tp.
         "q": LinearParams(
-            w=P(None, None, AXIS_TP),
+            w=P(None, AXIS_TP, None),
             b=P(None, AXIS_TP) if cfg.attn_bias else None,
         ),
         "k": LinearParams(
-            w=P(None, None, kv_axis),
+            w=P(None, kv_axis, None),
             b=P(None, kv_axis) if cfg.attn_bias else None,
         ),
         "v": LinearParams(
@@ -165,8 +171,9 @@ def param_shapes(cfg: DecoderConfig) -> Params:
 
     blocks: Params = {
         "ln1": norm_shape(True),
-        "q": LinearParams(sds(L, E, Q), sds(L, Q) if cfg.attn_bias else None),
-        "k": LinearParams(sds(L, E, KV), sds(L, KV) if cfg.attn_bias else None),
+        # q/k transposed storage [L, out, in] (see param_specs).
+        "q": LinearParams(sds(L, Q, E), sds(L, Q) if cfg.attn_bias else None),
+        "k": LinearParams(sds(L, KV, E), sds(L, KV) if cfg.attn_bias else None),
         "v": LinearParams(sds(L, E, KV), sds(L, KV) if cfg.attn_bias else None),
         "o": LinearParams(sds(L, Q, E), sds(L, E) if cfg.attn_bias else None),
     }
@@ -247,8 +254,8 @@ def _block(
     res = h
     x = _norm(cfg, h, bp["ln1"])
 
-    q = constrain(dense(x, bp["q"]).reshape(B, S, Hq, D), head_spec)
-    k = constrain(dense(x, bp["k"]).reshape(B, S, Hkv, D), kv_spec)
+    q = constrain(dense_t(x, bp["q"]).reshape(B, S, Hq, D), head_spec)
+    k = constrain(dense_t(x, bp["k"]).reshape(B, S, Hkv, D), kv_spec)
     v = constrain(dense(x, bp["v"]).reshape(B, S, Hkv, D), kv_spec)
 
     if cfg.positions == "rotary":
@@ -264,13 +271,14 @@ def _block(
     if defer_write:
         attn = fresh_kv_decode_attention(
             q, k_cache, v_cache, k, v, positions, kv_positions, slots,
-            scale=cfg.attn_scale,
+            scale=cfg.attn_scale, window=cfg.sliding_window,
         )
     else:
         k_cache, v_cache = write_layer(k_cache, v_cache, k, v, slots)
         attn = dispatch_attention(
             q, k_cache, v_cache, mask=mask, q_positions=positions,
             kv_positions=kv_positions, scale=cfg.attn_scale, mesh=mesh,
+            window=cfg.sliding_window,
         )
     attn = dense(attn.reshape(B, S, Hq * D), bp["o"])
     attn = constrain(attn, P(AXIS_DP, seq_ax, None))
@@ -315,12 +323,18 @@ def forward(
     """
     dtype = cfg.compute_dtype
 
-    # Vocab-parallel embedding as one-hot matmul: algebraically the
-    # reference's mask + partial-gather + psum (layers.py:200-213), and it
-    # stays on the MXU.
-    h = embedding(input_ids, params["wte"].astype(dtype), one_hot=True)
+    # Vocab-parallel embedding. Prefill uses the one-hot matmul formulation:
+    # algebraically the reference's mask + partial-gather + psum
+    # (layers.py:200-213), and it stays on the MXU. Decode (S=1) uses a
+    # gather — the one-hot matmul streams the whole [V, E] table through
+    # the MXU for one token (~5% of all param bytes per step at 1B scale),
+    # where a gather reads B·E floats.
+    one_hot = input_ids.shape[1] > 1
+    h = embedding(input_ids, params["wte"].astype(dtype), one_hot=one_hot)
     if cfg.positions == "learned":
-        h = h + embedding(positions, params["wpe"].astype(dtype), one_hot=True)
+        h = h + embedding(
+            positions, params["wpe"].astype(dtype), one_hot=one_hot
+        )
     h = constrain(h, P(AXIS_DP, _seq_axis(mesh, h.shape[1]), None))
 
     if kv_write_positions is None:

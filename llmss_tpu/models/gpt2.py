@@ -53,10 +53,13 @@ def load_params(
 
     def split_attn(key, lo, hi):
         # Conv1D c_attn is already [E, 3E]: Q|K|V along the output axis.
+        # q/k store [L, out, in] (decoder.param_specs), so they read the
+        # transposed view with the split range on axis 0; v keeps [in, out].
+        t = key in ("q", "k")
         return stacked_linear(
             ckpt, lambda i: name(i, "attn.c_attn"), L, mesh,
             specs["blocks"][key].w, specs["blocks"][key].b,
-            transpose=False, sub=(1, lo, hi),
+            transpose=t, sub=(0 if t else 1, lo, hi),
         )
 
     def lin(attr, key):

@@ -63,10 +63,13 @@ def load_params(
         # c_attn is [E + 2*kv, E] in torch Linear layout; transposed it is
         # [E, E + 2*kv] with Q at [:, :E], K at [:, E:E+kv], V at the rest
         # (the reference splits at gpt_bigcode_modeling.py:126-127).
+        # q/k store [L, out, in] (decoder.param_specs) — the disk layout is
+        # already [out, in], so their split range stays on the raw axis 0.
+        t = key not in ("q", "k")
         return stacked_linear(
             ckpt, lambda i: f"{h}.{i}.attn.c_attn", L, mesh,
             specs["blocks"][key].w, specs["blocks"][key].b,
-            transpose=True, sub=(1, lo, hi),
+            transpose=t, sub=(1 if t else 0, lo, hi),
         )
 
     def lin(attr, key):

@@ -58,10 +58,12 @@ def load_params(
     h = "transformer.h"
 
     def lin(attr, key, *, bias):
+        # q/k store [L, out, in] (decoder.param_specs) — the torch Linear
+        # disk layout is already [out, in], so they load untransposed.
         return stacked_linear(
             ckpt, lambda i: f"{h}.{i}.{attr}", L, mesh,
             specs["blocks"][key].w, specs["blocks"][key].b if bias else None,
-            transpose=True, bias=bias,
+            transpose=key not in ("q", "k"), bias=bias,
         )
 
     blocks: Params = {

@@ -55,9 +55,12 @@ def load_params(
     layers = "model.layers"
 
     def lin(attr, key):
+        # q/k store [L, out, in] (decoder.param_specs) — the torch Linear
+        # disk layout is already [out, in], so they load untransposed.
         return stacked_linear(
             ckpt, lambda i: f"{layers}.{i}.{attr}", L, mesh,
-            specs["blocks"][key].w, None, transpose=True, bias=False,
+            specs["blocks"][key].w, None,
+            transpose=key not in ("q", "k"), bias=False,
         )
 
     blocks: Params = {

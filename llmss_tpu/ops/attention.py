@@ -163,7 +163,9 @@ def dispatch_attention(
     - otherwise → XLA einsum path with the materialized mask.
 
     All paths implement identical semantics; the mask and the position pair
-    are two encodings of the same constraint."""
+    are two encodings of the same constraint, and ``window`` is applied
+    uniformly — the XLA fallback folds it into the mask here, so callers
+    never need to pre-bake it."""
     from llmss_tpu.ops import pallas_attention, ring_attention as ring_mod
 
     B, S, Hq, D = q.shape
@@ -240,4 +242,8 @@ def dispatch_attention(
                 local, mesh=mesh, in_specs=(qs, ks, ks, ps, ps),
                 out_specs=qs, check_vma=False,
             )(q, k, v, q_positions, kv_positions)
+    if window is not None:
+        mask = mask & (
+            kv_positions[:, None, :] > q_positions[:, :, None] - window
+        )
     return attention(q, k, v, mask, scale=scale)

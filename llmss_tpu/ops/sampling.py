@@ -10,6 +10,13 @@ reference's per-token ``dist.broadcast`` (``generate.py:144``).
 
 All warper parameters are per-request arrays (dynamic under jit) so a batch
 can mix greedy and sampled requests — required for continuous batching.
+
+Randomness is **per-row and stateless**: each draw uses
+``fold_in(key(seed_row), counter_row)`` where the counter is the absolute
+position of the token being sampled. Same request + same seed → identical
+sampled tokens, regardless of what else shares the batch, which generation
+mode runs it (streaming / fused / continuous), or admission order — the
+reproducibility the serving protocol's ``seed`` field promises.
 """
 
 from __future__ import annotations
@@ -18,10 +25,19 @@ import jax
 import jax.numpy as jnp
 
 
+def row_keys(seeds: jax.Array, counters: jax.Array) -> jax.Array:
+    """[B] PRNG keys, one per batch row: fold the token counter into the
+    request seed's key stream."""
+    return jax.vmap(
+        lambda s, c: jax.random.fold_in(jax.random.key(s), c)
+    )(seeds, counters)
+
+
 def sample(
     logits: jax.Array,  # [B, V] fp32
-    key: jax.Array,
     *,
+    seeds: jax.Array,  # [B] int32 per-request seed
+    counters: jax.Array,  # [B] int32 position of the token being sampled
     temperature: jax.Array,  # [B] f32; ignored where greedy
     top_k: jax.Array,  # [B] int32; <=0 disables
     top_p: jax.Array,  # [B] f32; 1.0 disables
@@ -41,6 +57,8 @@ def sample(
 
     temp = jnp.maximum(temperature, 1e-6)[:, None]
     scaled = logits / temp
+    keys = row_keys(seeds, counters)
+    categorical_rows = jax.vmap(jax.random.categorical)
 
     def _filtered_sample() -> jax.Array:
         order = jnp.argsort(-scaled, axis=-1)
@@ -54,7 +72,7 @@ def sample(
         keep = (rank < k_eff) & (cum_before < top_p[:, None])
         keep = keep.at[:, 0].set(True)
         filtered = jnp.where(keep, svals, float(jnp.finfo(jnp.float32).min))
-        choice = jax.random.categorical(key, filtered, axis=-1)
+        choice = categorical_rows(keys, filtered)
         return jnp.take_along_axis(
             order, choice[:, None], axis=-1
         )[:, 0].astype(jnp.int32)
@@ -62,7 +80,7 @@ def sample(
     def _plain_sample() -> jax.Array:
         # No top-k/top-p anywhere in the batch: categorical over the
         # temperature-scaled logits needs no sort.
-        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+        return categorical_rows(keys, scaled).astype(jnp.int32)
 
     any_sampled = jnp.any(~greedy)
     needs_filter = jnp.any(

@@ -33,6 +33,17 @@ class Broker(abc.ABC):
         self, request_id: str, timeout: float = 60.0
     ) -> GenerateResponse | None: ...
 
+    # Cancellation channel: the producer posts ids whose clients have gone
+    # away (timeout / explicit cancel); workers drain them and stop spending
+    # decode steps on those requests. The reference has no analogue — its
+    # consumer decodes to max_new_tokens no matter what
+    # (``consumer_server.py:123-166``), so a slow client wastes chip time.
+    def cancel_request(self, request_id: str) -> None:  # noqa: B027
+        pass
+
+    def pop_cancellations(self) -> list[str]:
+        return []
+
     # Workers publish their metrics snapshot through the broker so the
     # producer can serve GET /metrics even when producer and consumer are
     # separate processes (the reference has no metrics surface at all,
@@ -65,6 +76,17 @@ class InProcBroker(Broker):
         self._responses: dict[str, GenerateResponse] = {}
         self._cond = threading.Condition()
         self._metrics: dict = {}
+        self._cancels: list[str] = []
+        self._cancel_lock = threading.Lock()
+
+    def cancel_request(self, request_id: str) -> None:
+        with self._cancel_lock:
+            self._cancels.append(request_id)
+
+    def pop_cancellations(self) -> list[str]:
+        with self._cancel_lock:
+            out, self._cancels = self._cancels, []
+        return out
 
     def publish_metrics(self, metrics: dict) -> None:
         self._metrics = self._merged(metrics)
@@ -111,12 +133,25 @@ class RedisBroker(Broker):
     """
 
     def __init__(self, host: str = "localhost", port: int = 6379,
-                 request_queue: str = "pqueue", response_prefix: str = "squeue"):
+                 request_queue: str = "pqueue", response_prefix: str = "squeue",
+                 cancel_queue: str = "cancelq"):
         import redis  # gated: optional dependency
 
         self._r = redis.Redis(host=host, port=port)
         self._rq = request_queue
         self._prefix = response_prefix
+        self._cq = cancel_queue
+
+    def cancel_request(self, request_id: str) -> None:
+        self._r.lpush(self._cq, request_id)
+
+    def pop_cancellations(self) -> list[str]:
+        out = []
+        while True:
+            item = self._r.rpop(self._cq)
+            if item is None:
+                return out
+            out.append(item.decode() if isinstance(item, bytes) else item)
 
     def push_request(self, req: GenerateRequest) -> None:
         self._r.lpush(self._rq, req.to_json())

@@ -56,12 +56,20 @@ class Worker:
         tokenizer=None,
         batch_size: int = 8,
         poll_timeout_s: float = 0.2,
+        pad_batch: bool = True,
     ):
         self.engine = engine
         self.broker = broker
         self.tokenizer = tokenizer
         self.batch_size = batch_size
         self.poll_timeout_s = poll_timeout_s
+        self._cancelled: set[str] = set()
+        # Pad every live batch up to ``batch_size`` with inert rows so the
+        # engine sees one batch shape: without this, each distinct queue
+        # drain length compiles a fresh prefill+decode executable — repeated
+        # multi-second stalls under bursty load. Batch rows run in parallel
+        # on the chip, so the dummy rows are ~free.
+        self.pad_batch = pad_batch
 
     # -- request plumbing ---------------------------------------------------
 
@@ -88,13 +96,24 @@ class Worker:
 
     # -- serving loop -------------------------------------------------------
 
+    def _drain_cancellations(self) -> None:
+        self._cancelled.update(self.broker.pop_cancellations())
+
     def run_once(self) -> int:
+        self._drain_cancellations()
         batch = self._gather()
         if not batch:
             return 0
 
         prompts, gens, ok = [], [], []
         for req in batch:
+            if req.id in self._cancelled:
+                self._cancelled.discard(req.id)
+                self.engine.metrics.add_cancelled()
+                self.broker.push_response(
+                    GenerateResponse(id=req.id, error="cancelled")
+                )
+                continue
             try:
                 req.validate()
                 prompts.append(self._encode(req))
@@ -107,8 +126,31 @@ class Worker:
         if not ok:
             return len(batch)
 
+        n_live = len(prompts)
+        if self.pad_batch and n_live < self.batch_size:
+            pad = self.batch_size - n_live
+            prompts = prompts + [[0]] * pad
+            gens = gens + [
+                GenerationParams(max_new_tokens=1, is_greedy=True)
+            ] * pad
+
+        def cancel_poll():
+            # Mid-batch cancellation: stop spending decode steps on rows
+            # whose clients are gone.
+            self._drain_cancellations()
+            hit = [
+                i for i, req in enumerate(ok) if req.id in self._cancelled
+            ]
+            if hit:
+                self.engine.metrics.add_cancelled(len(hit))
+                for i in hit:
+                    self._cancelled.discard(ok[i].id)
+            return hit
+
         try:
-            outs = self.engine.generate(prompts, gens)
+            outs = self.engine.generate(
+                prompts, gens, cancel_poll=cancel_poll
+            )[:n_live]
         except Exception as e:  # noqa: BLE001 — batch failure containment
             logger.exception("batch failed")
             self.engine.metrics.add_error(len(ok))
@@ -196,6 +238,10 @@ class ContinuousWorker:
             n += 1
 
     def run_once(self) -> int:
+        for rid in self.broker.pop_cancellations():
+            # The batcher frees the row at the top of its next step; the
+            # request's done_cb fires with the tokens produced so far.
+            self.batcher.cancel(rid)
         n = self._drain_broker()
         self.batcher.step()
         self._publish_counter += 1

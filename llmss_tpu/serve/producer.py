@@ -47,6 +47,16 @@ class ProducerServer:
                     self._reply(404, {"error": "not found"})
 
             def do_POST(self):
+                if self.path == "/cancel":
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        rid = json.loads(self.rfile.read(n))["id"]
+                    except Exception as e:  # noqa: BLE001 — client error
+                        self._reply(400, {"error": str(e)})
+                        return
+                    outer.broker.cancel_request(rid)
+                    self._reply(200, {"cancelled": rid})
+                    return
                 if self.path != "/generate":
                     self._reply(404, {"error": "not found"})
                     return
@@ -60,6 +70,10 @@ class ProducerServer:
                 outer.broker.push_request(req)
                 resp = outer.broker.wait_response(req.id, outer.timeout_s)
                 if resp is None:
+                    # The client is gone; stop the worker spending decode
+                    # steps on this id (the reference keeps decoding to
+                    # max_new_tokens — wasted chip time + slow-client DoS).
+                    outer.broker.cancel_request(req.id)
                     self._reply(504, {"error": "timed out", "id": req.id})
                 elif resp.error:
                     self._reply(500, {"error": resp.error, "id": req.id})

@@ -84,6 +84,7 @@ class EngineMetrics:
         self.tokens_generated = 0
         self.requests_served = 0
         self.errors = 0
+        self.cancelled = 0
         self._start = time.time()
 
     def add_tokens(self, n: int) -> None:
@@ -98,17 +99,23 @@ class EngineMetrics:
         with self._lock:
             self.errors += n
 
+    def add_cancelled(self, n: int = 1) -> None:
+        with self._lock:
+            self.cancelled += n
+
     def to_dict(self) -> dict:
         uptime = time.time() - self._start
         with self._lock:
-            toks, reqs, errs = (
-                self.tokens_generated, self.requests_served, self.errors
+            toks, reqs, errs, canc = (
+                self.tokens_generated, self.requests_served, self.errors,
+                self.cancelled,
             )
         return {
             "uptime_s": round(uptime, 1),
             "requests_served": reqs,
             "tokens_generated": toks,
             "errors": errs,
+            "cancelled": canc,
             "tokens_per_sec_lifetime": round(toks / uptime, 2) if uptime else 0,
             "ttft": self.ttft.to_dict(),
             "prefill": self.prefill.to_dict(),

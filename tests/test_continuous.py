@@ -52,6 +52,61 @@ def test_interleaved_matches_isolated(engine):
         assert results[i] == expected[i], (i, results[i], expected[i])
 
 
+def test_sampled_seed_matches_isolated(engine):
+    """A sampled request's tokens depend only on (prompt, seed) — not on
+    admission order or batch mix: continuous batching must reproduce the
+    isolated engine.generate output for the same seed."""
+    prompts = [[i + 1, i + 2, i + 3] for i in range(4)]
+    gens = [
+        GenerationParams(
+            max_new_tokens=5, is_greedy=False, temperature=1.3, seed=100 + i,
+        )
+        for i in range(4)
+    ]
+    expected = [
+        engine.generate([p], g)[0] for p, g in zip(prompts, gens)
+    ]
+    batcher = ContinuousBatcher(engine, rows=2)
+    results = {}
+    for i, (p, g) in enumerate(zip(prompts, gens)):
+        batcher.submit(p, g, lambda toks, i=i: results.__setitem__(i, toks))
+    batcher.run_until_idle()
+    for i in range(4):
+        assert results[i] == expected[i], (i, results[i], expected[i])
+
+
+def test_cancel_frees_row_within_one_step(engine):
+    """A cancelled active request stops consuming decode steps within one
+    step: its row frees, its callback fires with the partial tokens, and
+    the rest of the batch is unaffected."""
+    results = {}
+    batcher = ContinuousBatcher(engine, rows=2)
+    long_gen = GenerationParams(max_new_tokens=40, is_greedy=True)
+    batcher.submit([1, 2, 3], long_gen, lambda t: results.__setitem__("a", t),
+                   req_id="a")
+    batcher.submit([4, 5], GenerationParams(max_new_tokens=6, is_greedy=True),
+                   lambda t: results.__setitem__("b", t), req_id="b")
+    for _ in range(3):
+        batcher.step()
+    assert "a" not in results
+    batcher.cancel("a")
+    batcher.step()  # processes the cancellation at the top of the step
+    assert "a" in results and 0 < len(results["a"]) < 40
+    assert not any(r.req_id == "a" for r in batcher.active.values())
+    assert engine.metrics.cancelled >= 1
+    # remaining request runs to completion untouched
+    batcher.run_until_idle()
+    assert len(results["b"]) == 6
+
+    # cancelling a *pending* (never admitted) request drops it silently
+    batcher2 = ContinuousBatcher(engine, rows=1)
+    batcher2.submit([1], long_gen, lambda t: results.__setitem__("c", t),
+                    req_id="c")
+    batcher2.cancel("c")
+    batcher2.step()
+    assert batcher2.idle and "c" not in results
+
+
 def test_staggered_admission(engine):
     """Requests submitted mid-flight join the running batch and still match
     their isolated outputs."""

@@ -124,13 +124,19 @@ def test_attention_mqa_broadcasts_kv():
     )
 
 
+def _sargs(n, seed=0, counter=0):
+    return dict(
+        seeds=jnp.full(n, seed, jnp.int32),
+        counters=jnp.full(n, counter, jnp.int32),
+    )
+
+
 def test_sampling_greedy_and_filters():
-    key = jax.random.key(0)
     logits = jnp.asarray(
         [[0.0, 1.0, 2.0, 3.0], [3.0, 2.0, 1.0, 0.0]], jnp.float32
     )
     tok = sample(
-        logits, key,
+        logits, **_sargs(2),
         temperature=jnp.ones(2), top_k=jnp.zeros(2, jnp.int32),
         top_p=jnp.ones(2), greedy=jnp.array([True, True]),
     )
@@ -138,7 +144,7 @@ def test_sampling_greedy_and_filters():
 
     # top_k=1 forces argmax even when sampling.
     tok = sample(
-        logits, jax.random.key(1),
+        logits, **_sargs(2, seed=1),
         temperature=jnp.ones(2), top_k=jnp.ones(2, jnp.int32),
         top_p=jnp.ones(2), greedy=jnp.array([False, False]),
     )
@@ -146,7 +152,7 @@ def test_sampling_greedy_and_filters():
 
     # tiny top_p keeps only the head of the nucleus.
     tok = sample(
-        logits, jax.random.key(2),
+        logits, **_sargs(2, seed=2),
         temperature=jnp.ones(2), top_k=jnp.zeros(2, jnp.int32),
         top_p=jnp.full(2, 1e-6), greedy=jnp.array([False, False]),
     )
@@ -159,7 +165,7 @@ def test_sampling_distribution_sane():
     toks = [
         int(
             sample(
-                logits, jax.random.key(i),
+                logits, **_sargs(1, seed=i),
                 temperature=jnp.full(1, 0.01),
                 top_k=jnp.zeros(1, jnp.int32),
                 top_p=jnp.ones(1),
@@ -169,3 +175,34 @@ def test_sampling_distribution_sane():
         for i in range(10)
     ]
     assert toks == [1] * 10
+
+
+def test_sampling_per_row_seed_determinism():
+    # Same (seed, counter) → same draw; different seed or counter → the
+    # stream moves. Rows are independent: a row's draw doesn't depend on
+    # what else is in the batch (the serving `seed` contract).
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(4, 64)), jnp.float32)
+    kw = dict(
+        temperature=jnp.ones(4), top_k=jnp.zeros(4, jnp.int32),
+        top_p=jnp.ones(4), greedy=jnp.zeros(4, bool),
+    )
+    seeds = jnp.asarray([7, 7, 8, 8], jnp.int32)
+    counters = jnp.asarray([3, 4, 3, 4], jnp.int32)
+    a = sample(logits, seeds=seeds, counters=counters, **kw)
+    b = sample(logits, seeds=seeds, counters=counters, **kw)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # Row 0 and row 2 share logits-row? No — use identical logits rows to
+    # compare across seeds/counters directly.
+    same = jnp.broadcast_to(logits[0], (4, 64))
+    t = sample(same, seeds=seeds, counters=counters, **kw)
+    t = np.asarray(t)
+    # batch-mix independence: row 0 alone gives the same token as row 0
+    # inside the batch of 4.
+    solo = sample(
+        same[:1], seeds=seeds[:1], counters=counters[:1],
+        temperature=jnp.ones(1), top_k=jnp.zeros(1, jnp.int32),
+        top_p=jnp.ones(1), greedy=jnp.zeros(1, bool),
+    )
+    assert int(solo[0]) == int(t[0])

@@ -122,3 +122,66 @@ def test_mixed_params_batch(serving):
     }).json()
     assert len(greedy["token_ids"]) == 3
     assert len(sampled["token_ids"]) == 6
+
+
+def test_cancelled_pending_request_is_skipped(serving):
+    """A request cancelled while still queued (e.g. producer timeout) must
+    not reach the engine: the worker answers it with a 'cancelled' error."""
+    _, engine = serving
+    broker = InProcBroker()
+    worker = Worker(engine, broker, batch_size=4, poll_timeout_s=0.01)
+    broker.push_request(GenerateRequest(
+        id="dead", token_ids=[1, 2], max_new_tokens=30, is_greedy=True,
+    ))
+    broker.cancel_request("dead")
+    before = engine.metrics.cancelled
+    worker.run_once()
+    resp = broker.wait_response("dead", timeout=10)
+    assert resp.error == "cancelled"
+    assert engine.metrics.cancelled == before + 1
+
+
+def test_cancel_http_route(serving):
+    server, _ = serving
+    r = httpx.post(
+        f"http://127.0.0.1:{server.port}/cancel", json={"id": "xyz"},
+        timeout=10,
+    )
+    assert r.status_code == 200 and r.json()["cancelled"] == "xyz"
+
+
+def test_no_recompile_across_batch_sizes(serving):
+    """Steady-state serving must reuse one executable per seq bucket no
+    matter how many requests each queue drain yields: the worker pads the
+    batch dim to its envelope (a fresh compile per live batch size would be
+    a multi-second stall under bursty load)."""
+    _, engine = serving
+    broker = InProcBroker()
+    worker = Worker(engine, broker, batch_size=4, poll_timeout_s=0.01)
+
+    def push(n, start):
+        ids = []
+        for i in range(n):
+            rid = f"r{start + i}"
+            broker.push_request(GenerateRequest(
+                id=rid, token_ids=[1 + i, 2, 3], max_new_tokens=3,
+                is_greedy=True,
+            ))
+            ids.append(rid)
+        return ids
+
+    ids = push(4, 0)  # full batch: compiles (or reuses) the envelope shape
+    worker.run_once()
+    for rid in ids:
+        assert broker.wait_response(rid, timeout=30).error is None
+    base_prefill = engine._prefill._cache_size()
+    base_decode = engine._decode._cache_size()
+
+    for n, start in ((1, 10), (3, 20), (2, 30)):
+        ids = push(n, start)
+        worker.run_once()
+        for rid in ids:
+            assert broker.wait_response(rid, timeout=30).error is None
+
+    assert engine._prefill._cache_size() == base_prefill
+    assert engine._decode._cache_size() == base_decode

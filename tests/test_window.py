@@ -1,0 +1,188 @@
+"""Sliding-window attention equivalence across every implementation path.
+
+ADVICE r1 (medium): ``DecoderConfig.sliding_window`` must actually constrain
+attention in all four implementations — XLA mask fallback, Pallas flash
+kernel, sequence-parallel ring/LSE-merge, and the deferred-write fresh-KV
+decode path — and at the model level (a windowed model must decode the same
+tokens streaming as it does re-prefilling the full prefix each step).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import importlib
+
+attn_mod = importlib.import_module("llmss_tpu.ops.attention")
+from llmss_tpu.ops.attention import (
+    attention,
+    dispatch_attention,
+    fresh_kv_decode_attention,
+    make_causal_mask,
+)
+from llmss_tpu.parallel import MeshPlan, make_mesh
+
+W = 8  # window width under test
+
+
+def _rand(rng, *shape):
+    return jnp.asarray(rng.normal(size=shape), jnp.float32)
+
+
+def _ref(q, k, v, q_pos, kv_pos):
+    return attention(
+        q, k, v, make_causal_mask(q_pos, kv_pos, kv_pos >= 0, window=W)
+    )
+
+
+def _case(rng, B, S, T, Hq, Hkv, D):
+    q = _rand(rng, B, S, Hq, D)
+    k, v = _rand(rng, B, T, Hkv, D), _rand(rng, B, T, Hkv, D)
+    kv_pos = jnp.asarray(np.broadcast_to(np.arange(T), (B, T)), np.int32)
+    q_pos = jnp.asarray(
+        np.broadcast_to(np.arange(T - S, T), (B, S)), np.int32
+    )
+    return q, k, v, q_pos, kv_pos
+
+
+def test_window_xla_fallback_applies_window():
+    """dispatch_attention folds ``window`` into the mask on the XLA path —
+    the caller's mask carries only causality/validity (ADVICE r1 low)."""
+    rng = np.random.default_rng(0)
+    q, k, v, q_pos, kv_pos = _case(rng, 2, 16, 64, 4, 4, 16)
+    plain_mask = make_causal_mask(q_pos, kv_pos, kv_pos >= 0)  # no window
+    out = dispatch_attention(
+        q, k, v, mask=plain_mask, q_positions=q_pos, kv_positions=kv_pos,
+        window=W, mesh=None,
+    )
+    ref = _ref(q, k, v, q_pos, kv_pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    # And the window genuinely bites: T=64 history with W=8 differs from
+    # full causal.
+    full = attention(q, k, v, plain_mask)
+    assert not np.allclose(np.asarray(out), np.asarray(full), atol=1e-3)
+
+
+def test_window_pallas_parity():
+    from llmss_tpu.ops.pallas_attention import flash_attention
+
+    rng = np.random.default_rng(1)
+    q, k, v, q_pos, kv_pos = _case(rng, 2, 32, 128, 8, 2, 32)
+    ref = _ref(q, k, v, q_pos, kv_pos)
+    out = flash_attention(q, k, v, q_pos, kv_pos, window=W, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2)
+
+
+def test_window_ring_and_lse_merge_parity(devices):
+    mesh = make_mesh(MeshPlan(dp=1, sp=4, tp=2))
+    rng = np.random.default_rng(2)
+
+    # Prefill-shaped (S == T, divisible by sp) → ring path.
+    q, k, v, q_pos, kv_pos = _case(rng, 2, 32, 32, 8, 4, 16)
+    out = dispatch_attention(
+        q, k, v, mask=None, q_positions=q_pos, kv_positions=kv_pos,
+        window=W, mesh=mesh,
+    )
+    ref = _ref(q, k, v, q_pos, kv_pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    # Decode-shaped (S == 1) → split-KV LSE-merge path.
+    q1, k1, v1, _, kv_pos1 = _case(rng, 2, 1, 32, 8, 4, 16)
+    q_pos1 = jnp.full((2, 1), 31, jnp.int32)
+    out1 = dispatch_attention(
+        q1, k1, v1, mask=None, q_positions=q_pos1, kv_positions=kv_pos1,
+        window=W, mesh=mesh,
+    )
+    ref1 = _ref(q1, k1, v1, q_pos1, kv_pos1)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(ref1), atol=1e-5)
+
+
+def test_window_fresh_kv_decode_parity():
+    """Deferred-write decode: stale cache + fresh token under a window must
+    equal attention over the written cache with the same window."""
+    rng = np.random.default_rng(3)
+    B, T, Hq, Hkv, D = 2, 32, 4, 2, 16
+    cur = 20  # decoding position `cur`; slots 0..cur-1 hold the history
+    q = _rand(rng, B, 1, Hq, D)
+    k_c, v_c = _rand(rng, B, T, Hkv, D), _rand(rng, B, T, Hkv, D)
+    k_n, v_n = _rand(rng, B, 1, Hkv, D), _rand(rng, B, 1, Hkv, D)
+    kv_pos_old = np.full((B, T), -1, np.int32)
+    kv_pos_old[:, :cur] = np.arange(cur)
+    kv_pos_old = jnp.asarray(kv_pos_old)
+    q_pos = jnp.full((B, 1), cur, jnp.int32)
+    slots = jnp.full((B, 1), cur, jnp.int32)
+
+    out = fresh_kv_decode_attention(
+        q, k_c, v_c, k_n, v_n, q_pos, kv_pos_old, slots, window=W,
+    )
+
+    # Reference: write the fresh KV, then windowed attention over the cache.
+    b = jnp.arange(B)[:, None]
+    k_full = k_c.at[b, slots].set(k_n)
+    v_full = v_c.at[b, slots].set(v_n)
+    kv_pos_new = kv_pos_old.at[b, slots].set(q_pos)
+    ref = _ref(q, k_full, v_full, q_pos, kv_pos_new)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_window_model_streaming_matches_reprefill(devices):
+    """Model level: with cfg.sliding_window set, streaming decode (fresh-KV
+    deferred-write path) must emit the same greedy tokens as re-prefilling
+    the growing prefix every step (mask path)."""
+    from llmss_tpu.engine import DecodeEngine, GenerationParams
+    from llmss_tpu.models.common import DecoderConfig
+    from llmss_tpu.models.decoder import init_params
+
+    mesh = make_mesh(MeshPlan(dp=1, sp=1, tp=8))
+    cfg = DecoderConfig(
+        model_type="llama", vocab_size=128, hidden_size=64, n_layers=2,
+        n_heads=8, n_kv_heads=4, head_dim=8, intermediate_size=192,
+        max_position_embeddings=64, activation="silu", norm="rmsnorm",
+        norm_eps=1e-5, mlp="swiglu", positions="rotary", rope_style="half",
+        rotary_dim=8, attn_bias=False, mlp_bias=False,
+        tie_word_embeddings=False, dtype="float32", sliding_window=4,
+    )
+    params = init_params(cfg, mesh, jax.random.key(0))
+    engine = DecodeEngine(cfg, params, mesh, max_seq_len=32)
+
+    prompt = [3, 17, 99, 54, 23, 8]
+    n_new = 10
+    gen = GenerationParams(max_new_tokens=n_new, is_greedy=True)
+    streamed = engine.generate([prompt], gen)[0]
+
+    # Re-prefill the full prefix each step; greedy argmax must agree.
+    prefix = list(prompt)
+    for t in streamed:
+        cache = engine.new_cache(1)
+        ids, lens = engine._pad_prompts([prefix])
+        sa = engine._sample_args(gen, 1)
+        tok, _, _ = engine._prefill(
+            engine.params, jnp.asarray(ids), cache, jnp.asarray(lens), sa,
+        )
+        assert int(np.asarray(tok)[0]) == t, (prefix, streamed)
+        prefix.append(t)
+
+    # The window genuinely bites: with the window removed, prefill logits
+    # over the same (longer-than-window) prefix must change numerically.
+    # (Greedy argmax can coincide on a random-init model; logits can't.)
+    cfg_full = DecoderConfig(**{
+        **{f: getattr(cfg, f) for f in cfg.__dataclass_fields__},
+        "sliding_window": None,
+    })
+    engine_full = DecodeEngine(cfg_full, params, mesh, max_seq_len=32)
+    ids, lens = engine._pad_prompts([prefix])
+    sa = engine._sample_args(gen, 1)
+    _, logits_w, _ = engine._prefill(
+        engine.params, jnp.asarray(ids), engine.new_cache(1),
+        jnp.asarray(lens), sa,
+    )
+    _, logits_f, _ = engine_full._prefill(
+        engine_full.params, jnp.asarray(ids), engine_full.new_cache(1),
+        jnp.asarray(lens), sa,
+    )
+    assert not np.allclose(
+        np.asarray(logits_w), np.asarray(logits_f), atol=1e-4
+    )
