@@ -233,6 +233,7 @@ def _block(
     mask: jax.Array | None,  # [B, S, T] (None in defer_write mode)
     mesh=None,
     defer_write: bool = False,
+    ablate: str | None = None,  # profiling only (tools/profile_decode.py)
 ):
     """One decoder block.
 
@@ -268,7 +269,9 @@ def _block(
             style=cfg.rope_style,
         )
 
-    if defer_write:
+    if ablate == "no_attn":
+        attn = q  # passthrough: ablates the cache read + softmax einsums
+    elif defer_write:
         attn = fresh_kv_decode_attention(
             q, k_cache, v_cache, k, v, positions, kv_positions, slots,
             scale=cfg.attn_scale, window=cfg.sliding_window,
@@ -309,6 +312,7 @@ def forward(
     gather_idx: jax.Array | None = None,  # [B] per-row index into S
     kv_write_positions: jax.Array | None = None,  # [B, S]; -1 marks padding
     mesh=None,  # enables the Pallas attention path (shard_map needs a Mesh)
+    _ablate: str | None = None,  # profiling-only component removal
 ) -> tuple[jax.Array, KVCache]:
     """Run the decoder; returns (logits fp32, updated cache).
 
@@ -353,21 +357,26 @@ def forward(
             bp, k_l, v_l = xs
             h, k_f, v_f = _block(
                 cfg, bp, h, positions, k_l, v_l, cache.positions, slots,
-                None, mesh=mesh, defer_write=True,
+                None, mesh=mesh, defer_write=True, ablate=_ablate,
             )
-            return h, (k_f, v_f)
+            ys = None if _ablate == "no_scatter" else (k_f, v_f)
+            return h, ys
 
-        h, (k_fresh, v_fresh) = jax.lax.scan(
+        h, ys = jax.lax.scan(
             body, h, (params["blocks"], cache.k, cache.v)
         )
-        B = input_ids.shape[0]
-        b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
-        k_new = cache.k.at[:, b_idx, slots].set(
-            k_fresh.astype(cache.k.dtype)
-        )
-        v_new = cache.v.at[:, b_idx, slots].set(
-            v_fresh.astype(cache.v.dtype)
-        )
+        if _ablate == "no_scatter":
+            k_new, v_new = cache.k, cache.v
+        else:
+            k_fresh, v_fresh = ys
+            B = input_ids.shape[0]
+            b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+            k_new = cache.k.at[:, b_idx, slots].set(
+                k_fresh.astype(cache.k.dtype)
+            )
+            v_new = cache.v.at[:, b_idx, slots].set(
+                v_fresh.astype(cache.v.dtype)
+            )
     else:
         kv_valid = new_kv_positions >= 0
         mask = make_causal_mask(positions, new_kv_positions, kv_valid)
@@ -391,6 +400,9 @@ def forward(
     elif last_only:
         h = h[:, -1:, :]
 
+    if _ablate == "no_head":
+        logits = h[..., :8].astype(jnp.float32)
+        return logits, KVCache(k=k_new, v=v_new, positions=new_kv_positions)
     if cfg.tie_word_embeddings:
         # Tied head (gpt_bigcode_modeling.py:792-797): contract against the
         # vocab-sharded embedding; constraining the output replicated makes

@@ -1,0 +1,210 @@
+"""On-chip decode-step profiler: op-level breakdown + ablation timings.
+
+Produces the receipts behind PROFILE.md: where each microsecond of the
+decode step goes, measured two independent ways —
+
+1. **xprof op table**: a ``jax.profiler`` trace of the steady-state fused
+   decode scan, parsed into per-op self-time via the tensorboard-plugin-
+   profile converter (no TensorBoard UI needed).
+2. **Ablation timings**: variants of the decode step with one component
+   removed (lm-head, sampling, cache scatter, attention) compiled and timed
+   separately; the delta attributes wall time to the removed component.
+
+Run on the bench host: ``python tools/profile_decode.py``.
+Writes ``PROFILE.md`` (top-op table + ablations) and prints a JSON summary.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import BATCH, DECODE, HBM_GBPS, PROMPT, flagship_cfg  # noqa: E402
+
+TRACE_DIR = os.environ.get("PROFILE_TRACE_DIR", "/tmp/llmss_profile")
+
+
+def _build():
+    from llmss_tpu.engine import DecodeEngine, GenerationParams
+    from llmss_tpu.models.decoder import init_params
+    from llmss_tpu.parallel import MeshPlan, make_mesh
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshPlan(tp=n_dev))
+    cfg = flagship_cfg()
+    params = init_params(cfg, mesh, jax.random.key(0))
+    engine = DecodeEngine(cfg, params, mesh, max_seq_len=PROMPT + DECODE)
+    return cfg, params, mesh, engine
+
+
+def _prompts(cfg):
+    rng = np.random.default_rng(0)
+    return [
+        rng.integers(0, cfg.vocab_size, PROMPT).tolist() for _ in range(BATCH)
+    ]
+
+
+def _timed(fn, *args, n=5, **kw):
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+# -- ablation variants --------------------------------------------------------
+
+
+def _step_variant(cfg, mesh, variant: str):
+    """A fused N-step decode scan with one component removed."""
+    from llmss_tpu.models.decoder import forward
+    from llmss_tpu.ops.sampling import sample
+
+    def body(params, sample_args, carry, _):
+        tokens, cache, cur_pos = carry
+        positions = cur_pos[:, None]
+        slots = positions % cache.max_len
+        logits, cache = forward(
+            cfg, params, tokens[:, None], positions, cache, slots,
+            last_only=True, mesh=mesh,
+            _ablate=variant if variant not in ("full", "no_sample") else None,
+        )
+        if variant in ("no_sample", "no_head"):
+            # Trivial data-dependent token keeps the logits live (no DCE)
+            # without paying argmax-over-V; no_head additionally skips the
+            # vocab projection itself. head cost = t(no_sample) - t(no_head).
+            tok = logits[:, 0, 0].astype(jnp.int32) % cfg.vocab_size
+        else:
+            tok = sample(logits[:, 0], counters=cur_pos + 1, **sample_args)
+        return (tok, cache, cur_pos + 1), tok
+
+    def many(params, tokens, cache, cur_pos, sample_args, n_steps):
+        carry, toks = jax.lax.scan(
+            partial(body, params, sample_args), (tokens, cache, cur_pos),
+            None, length=n_steps,
+        )
+        return toks, carry[1]
+
+    return jax.jit(many, donate_argnums=(2,), static_argnames=("n_steps",))
+
+
+def run_ablations(cfg, mesh, engine, prompts):
+    """Time decode-scan variants; each removal's delta vs full = its cost."""
+    from llmss_tpu.engine import GenerationParams
+
+    gen = GenerationParams(max_new_tokens=8, is_greedy=True)
+    sa = engine._sample_args(gen, BATCH)
+    ids, lens = engine._pad_prompts(prompts)
+
+    N = 64
+    results = {}
+    for variant in ("full", "no_sample", "no_head", "no_scatter", "no_attn"):
+        stepper = _step_variant(cfg, mesh, variant)
+        cache = engine.new_cache(BATCH)
+        tok, _, cache = engine._prefill(
+            engine.params, jnp.asarray(ids), cache, jnp.asarray(lens), sa,
+        )
+        cur = jnp.asarray(lens)
+        # warm
+        toks, cache = stepper(engine.params, tok, cache, cur, sa, N)
+        jax.block_until_ready(toks)
+        t0 = time.perf_counter()
+        toks, cache = stepper(engine.params, tok, cache, cur, sa, N)
+        jax.block_until_ready(toks)
+        dt = (time.perf_counter() - t0) / N
+        results[variant] = dt * 1e3  # ms/step
+        del cache
+    return results
+
+
+# -- xprof trace --------------------------------------------------------------
+
+
+def capture_trace(engine, prompts):
+    from llmss_tpu.engine import GenerationParams
+
+    gen = GenerationParams(max_new_tokens=DECODE, is_greedy=True)
+    engine.generate_fused(prompts, gen)  # warm/compile
+    os.makedirs(TRACE_DIR, exist_ok=True)
+    jax.profiler.start_trace(TRACE_DIR)
+    engine.generate_fused(prompts, gen)
+    jax.profiler.stop_trace()
+
+
+def parse_trace() -> list[dict] | None:
+    """Extract per-op self-time from the xplane via the xprof converter."""
+    paths = sorted(
+        glob.glob(os.path.join(TRACE_DIR, "**", "*.xplane.pb"),
+                  recursive=True),
+        key=os.path.getmtime,
+    )
+    if not paths:
+        return None
+    xspace = [paths[-1]]
+    for tool in ("framework_op_stats", "tensorflow_stats", "op_profile"):
+        try:
+            from tensorboard_plugin_profile.convert import raw_to_tool_data
+            data, _ = raw_to_tool_data.xspace_to_tool_data(
+                xspace, tool, {}
+            )
+            return _digest_tool(tool, data)
+        except Exception as e:  # noqa: BLE001 — try the next tool
+            print(f"[profile] {tool} failed: {e!r}", file=sys.stderr)
+    return None
+
+
+def _digest_tool(tool: str, data) -> list[dict] | None:
+    if isinstance(data, bytes):
+        data = data.decode("utf-8", "replace")
+    if tool in ("framework_op_stats", "tensorflow_stats"):
+        # gviz JSON table; columns include op name + self time.
+        try:
+            tbl = json.loads(data)
+        except json.JSONDecodeError:
+            return None
+        cols = [c.get("label", c.get("id", "")) for c in tbl.get("cols", [])]
+        rows = []
+        for r in tbl.get("rows", []):
+            vals = [c.get("v") for c in r.get("c", [])]
+            rows.append(dict(zip(cols, vals)))
+        return rows
+    return None
+
+
+def main():
+    cfg, params, mesh, engine = _build()
+    prompts = _prompts(cfg)
+
+    ablations = run_ablations(cfg, mesh, engine, prompts)
+    capture_trace(engine, prompts)
+    ops = parse_trace()
+
+    full = ablations.get("full")
+    print(json.dumps({
+        "ablations_ms_per_step": {k: round(v, 3) for k, v in ablations.items()},
+        "deltas_ms": {
+            k: round(full - v, 3)
+            for k, v in ablations.items() if k != "full" and full
+        },
+        "n_trace_ops": len(ops) if ops else 0,
+    }))
+    if ops:
+        with open("/tmp/llmss_ops.json", "w") as f:
+            json.dump(ops, f, indent=1)
+        print("op table -> /tmp/llmss_ops.json")
+
+
+if __name__ == "__main__":
+    main()
