@@ -13,9 +13,20 @@ bytes from HBM, so
 ``vs_baseline`` = measured / roofline — i.e. the fraction of the chip's
 theoretical decode ceiling this framework reaches (1.0 is perfect).
 
+Methodology: steady-state decode cost is the **marginal** time per fused
+decode step, measured by the slope method — run the fused scan at two step
+counts and take (t(N2) - t(N1)) / (N2 - N1). This cancels constant per-call
+overhead (on the axon bench host the tunnel adds ~90 ms of dispatch + fetch
+latency per call, which is host-link artifact, not framework cost) and
+matches what a long-running serving process sustains. Prefill latency is
+its own number (TTFT, reported in ``unit``), not smeared into decode
+throughput. As an independent cross-check on the roofline accounting, the
+achieved HBM rate implied by the measured step time over the bytes the step
+must stream (params + full KV buffer) is also reported in ``unit``.
+
 Model: Llama-architecture ~1.2B (the BASELINE.md config-ladder scale that
-fits one v5e chip with headroom), random-init bf16, batch 8, 128-token
-prefill, fused 128-token decode.
+fits one v5e chip with headroom), random-init bf16, batch 16, 128-token
+prefill, fused decode.
 """
 
 from __future__ import annotations
@@ -63,6 +74,57 @@ def flagship_cfg():
     )
 
 
+N_SLOPE = (64, 320)  # fused-scan step counts for the slope method
+
+
+def slope_time(prepare, n_slope=N_SLOPE, reps: int = 3) -> tuple[float, float]:
+    """Marginal ms per decode step + constant ms, via the slope method.
+
+    ``prepare(n)`` must return a zero-arg callable that runs one fused
+    n-step scan **to completion** — force it with a host fetch of a scalar
+    reduction; ``block_until_ready`` can return at dispatch time over the
+    axon tunnel. The single methodology shared by bench.py and
+    tools/profile_decode.py.
+    """
+    times = {}
+    for n in n_slope:
+        run = prepare(n)
+        run()  # compile + warm
+        best = float("inf")
+        for _i in range(reps):
+            t0 = time.perf_counter()
+            run()
+            best = min(best, time.perf_counter() - t0)
+        times[n] = best
+    n1, n2 = n_slope
+    slope_ms = (times[n2] - times[n1]) / (n2 - n1) * 1e3
+    const_ms = times[n1] * 1e3 - slope_ms * n1
+    return slope_ms, const_ms
+
+
+def _decode_slope_ms(engine, ids, lens, sa, eos) -> float:
+    def prepare(n):
+        cache = engine.new_cache(BATCH)
+        tok, _, cache = engine._prefill(
+            engine.params, jnp.asarray(ids), cache, jnp.asarray(lens), sa,
+        )
+        cur = jnp.asarray(lens)
+        done = jnp.zeros(BATCH, bool)
+        state = {"cache": cache}
+
+        def run():
+            out = engine._decode_many(
+                engine.params, tok, state["cache"], cur, sa, done, eos,
+                n_steps=n,
+            )
+            toks, state["cache"] = out[0], out[1]
+            _ = float(jnp.sum(toks))  # forced completion
+
+        return run
+
+    return slope_time(prepare)[0]
+
+
 def main():
     from llmss_tpu.engine import DecodeEngine, GenerationParams
     from llmss_tpu.models.decoder import init_params
@@ -79,36 +141,39 @@ def main():
 
     max_seq = PROMPT + DECODE
     engine = DecodeEngine(cfg, params, mesh, max_seq_len=max_seq)
-    gen_warm = GenerationParams(max_new_tokens=8, is_greedy=True)
     gen = GenerationParams(max_new_tokens=DECODE, is_greedy=True)
 
     rng = np.random.default_rng(0)
     prompts = [
         rng.integers(0, cfg.vocab_size, PROMPT).tolist() for _ in range(BATCH)
     ]
-
-    # Warmup (compile prefill + decode_many for both step counts).
-    engine.generate_fused(prompts, gen_warm)
-    engine.generate_fused(prompts, gen)
-
-    # TTFT: prefill + first sampled token, compiled.
-    cache = engine.new_cache(BATCH)
     ids, lens = engine._pad_prompts(prompts)
     sa = engine._sample_args(gen, BATCH)
-    t0 = time.perf_counter()
+    eos = jnp.int32(-1)
+
+    # Warmup: compile prefill once.
+    cache = engine.new_cache(BATCH)
     tok, _, cache = engine._prefill(
         engine.params, jnp.asarray(ids), cache, jnp.asarray(lens), sa,
     )
-    tok.block_until_ready()
-    ttft_ms = (time.perf_counter() - t0) * 1e3
+    _ = np.asarray(tok)
     del cache
 
-    # Decode throughput: fused generation, steady state.
-    t0 = time.perf_counter()
-    out = engine.generate_fused(prompts, gen)
-    dt = time.perf_counter() - t0
-    n_tokens = sum(len(o) for o in out)
-    tok_per_sec_per_chip = n_tokens / dt / n_dev
+    # TTFT: prefill + first sampled token on host, compiled path.
+    ttft_ms = float("inf")
+    for _i in range(3):
+        cache = engine.new_cache(BATCH)
+        t0 = time.perf_counter()
+        tok, _, cache = engine._prefill(
+            engine.params, jnp.asarray(ids), cache, jnp.asarray(lens), sa,
+        )
+        _ = np.asarray(tok)  # the token must actually reach the host
+        ttft_ms = min(ttft_ms, (time.perf_counter() - t0) * 1e3)
+        del cache
+
+    # Decode throughput: marginal fused-step cost, steady state.
+    step_ms = _decode_slope_ms(engine, ids, lens, sa, eos)
+    tok_per_sec_per_chip = BATCH / (step_ms * 1e-3) / n_dev
 
     kv_bytes_per_token = (
         2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * 2 * max_seq / 2
@@ -116,10 +181,20 @@ def main():
     roofline = BATCH * HBM_GBPS * 1e9 / (
         param_bytes + BATCH * kv_bytes_per_token
     )
+    # Independent cross-check: the step must stream at least params + the
+    # full KV buffer (einsums read all T slots of the ring buffer); the
+    # achieved HBM rate over those bytes bounds the accounting from below.
+    kv_buffer_bytes = 2 * cfg.n_layers * BATCH * max_seq * (
+        cfg.n_kv_heads * cfg.head_dim * 2
+    )
+    achieved_gbps = (param_bytes + kv_buffer_bytes) / (step_ms * 1e-3) / 1e9
     result = {
         "metric": "decode_tokens_per_sec_per_chip",
         "value": round(tok_per_sec_per_chip, 1),
-        "unit": f"tok/s/chip (1.2B bf16, batch={BATCH}, ttft_ms={ttft_ms:.0f})",
+        "unit": (
+            f"tok/s/chip (1.2B bf16, batch={BATCH}, ttft_ms={ttft_ms:.0f}, "
+            f"step_ms={step_ms:.2f}, achieved_hbm_gbps={achieved_gbps:.0f})"
+        ),
         "vs_baseline": round(tok_per_sec_per_chip / roofline, 3),
     }
     print(json.dumps(result))

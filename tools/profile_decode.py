@@ -4,11 +4,19 @@ Produces the receipts behind PROFILE.md: where each microsecond of the
 decode step goes, measured two independent ways —
 
 1. **xprof op table**: a ``jax.profiler`` trace of the steady-state fused
-   decode scan, parsed into per-op self-time via the tensorboard-plugin-
-   profile converter (no TensorBoard UI needed).
+   decode scan, parsed into per-op self-time via the xprof converter
+   (no TensorBoard UI needed).
 2. **Ablation timings**: variants of the decode step with one component
    removed (lm-head, sampling, cache scatter, attention) compiled and timed
    separately; the delta attributes wall time to the removed component.
+
+Timing methodology — the axon TPU tunnel adds ~90 ms of constant per-call
+overhead (dispatch + host fetch round-trip), and ``block_until_ready`` can
+return at dispatch-time on the first call after compile. Every timing here
+therefore (a) forces completion with a host fetch of a scalar reduction and
+(b) uses the **slope method**: run the fused scan at two step counts and
+take (t(N2) - t(N1)) / (N2 - N1), which cancels all constant overhead and
+yields the true marginal cost per decode step.
 
 Run on the bench host: ``python tools/profile_decode.py``.
 Writes ``PROFILE.md`` (top-op table + ablations) and prints a JSON summary.
@@ -29,7 +37,9 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import BATCH, DECODE, HBM_GBPS, PROMPT, flagship_cfg  # noqa: E402
+from bench import (  # noqa: E402
+    BATCH, DECODE, HBM_GBPS, PROMPT, flagship_cfg, slope_time,
+)
 
 TRACE_DIR = os.environ.get("PROFILE_TRACE_DIR", "/tmp/llmss_profile")
 
@@ -54,16 +64,6 @@ def _prompts(cfg):
     ]
 
 
-def _timed(fn, *args, n=5, **kw):
-    out = fn(*args, **kw)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(n):
-        out = fn(*args, **kw)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / n
-
-
 # -- ablation variants --------------------------------------------------------
 
 
@@ -82,10 +82,14 @@ def _step_variant(cfg, mesh, variant: str):
             _ablate=variant if variant not in ("full", "no_sample") else None,
         )
         if variant in ("no_sample", "no_head"):
-            # Trivial data-dependent token keeps the logits live (no DCE)
-            # without paying argmax-over-V; no_head additionally skips the
-            # vocab projection itself. head cost = t(no_sample) - t(no_head).
-            tok = logits[:, 0, 0].astype(jnp.int32) % cfg.vocab_size
+            # A full-logits reduction keeps every vocab column live (a
+            # single-element read would let XLA fold the slice into the head
+            # matmul, silently ablating it) without paying argmax-over-V;
+            # no_head additionally skips the vocab projection itself.
+            # head-only cost = t(no_sample) - t(no_head).
+            tok = jnp.sum(logits[:, 0], axis=-1).astype(
+                jnp.int32
+            ) % cfg.vocab_size
         else:
             tok = sample(logits[:, 0], counters=cur_pos + 1, **sample_args)
         return (tok, cache, cur_pos + 1), tok
@@ -108,24 +112,29 @@ def run_ablations(cfg, mesh, engine, prompts):
     sa = engine._sample_args(gen, BATCH)
     ids, lens = engine._pad_prompts(prompts)
 
-    N = 64
     results = {}
     for variant in ("full", "no_sample", "no_head", "no_scatter", "no_attn"):
         stepper = _step_variant(cfg, mesh, variant)
-        cache = engine.new_cache(BATCH)
-        tok, _, cache = engine._prefill(
-            engine.params, jnp.asarray(ids), cache, jnp.asarray(lens), sa,
-        )
-        cur = jnp.asarray(lens)
-        # warm
-        toks, cache = stepper(engine.params, tok, cache, cur, sa, N)
-        jax.block_until_ready(toks)
-        t0 = time.perf_counter()
-        toks, cache = stepper(engine.params, tok, cache, cur, sa, N)
-        jax.block_until_ready(toks)
-        dt = (time.perf_counter() - t0) / N
-        results[variant] = dt * 1e3  # ms/step
-        del cache
+
+        def prepare(n):
+            cache = engine.new_cache(BATCH)
+            tok, _, cache = engine._prefill(
+                engine.params, jnp.asarray(ids), cache, jnp.asarray(lens),
+                sa,
+            )
+            cur = jnp.asarray(lens)
+            state = {"cache": cache}
+
+            def run():
+                toks, state["cache"] = stepper(
+                    engine.params, tok, state["cache"], cur, sa, n
+                )
+                _ = float(jnp.sum(toks))  # forced completion
+
+            return run
+
+        slope_ms, const_ms = slope_time(prepare)
+        results[variant] = {"ms_per_step": slope_ms, "const_ms": const_ms}
     return results
 
 
@@ -153,51 +162,174 @@ def parse_trace() -> list[dict] | None:
     if not paths:
         return None
     xspace = [paths[-1]]
-    for tool in ("framework_op_stats", "tensorflow_stats", "op_profile"):
+    data = None
+    for modname in ("xprof.convert", "tensorboard_plugin_profile.convert"):
         try:
-            from tensorboard_plugin_profile.convert import raw_to_tool_data
-            data, _ = raw_to_tool_data.xspace_to_tool_data(
-                xspace, tool, {}
+            import importlib
+
+            raw_to_tool_data = importlib.import_module(
+                f"{modname}.raw_to_tool_data"
             )
-            return _digest_tool(tool, data)
-        except Exception as e:  # noqa: BLE001 — try the next tool
-            print(f"[profile] {tool} failed: {e!r}", file=sys.stderr)
-    return None
-
-
-def _digest_tool(tool: str, data) -> list[dict] | None:
+            data, _ = raw_to_tool_data.xspace_to_tool_data(
+                xspace, "framework_op_stats", {}
+            )
+            break
+        except Exception as e:  # noqa: BLE001 — try the next converter
+            print(f"[profile] {modname} failed: {e!r}", file=sys.stderr)
+    if data is None:
+        return None
     if isinstance(data, bytes):
         data = data.decode("utf-8", "replace")
-    if tool in ("framework_op_stats", "tensorflow_stats"):
-        # gviz JSON table; columns include op name + self time.
-        try:
-            tbl = json.loads(data)
-        except json.JSONDecodeError:
-            return None
-        cols = [c.get("label", c.get("id", "")) for c in tbl.get("cols", [])]
-        rows = []
-        for r in tbl.get("rows", []):
-            vals = [c.get("v") for c in r.get("c", [])]
-            rows.append(dict(zip(cols, vals)))
-        return rows
-    return None
+    try:
+        tbl = json.loads(data)
+    except json.JSONDecodeError:
+        return None
+    if isinstance(tbl, list):
+        tbl = tbl[0]
+    cols = [c.get("label", c.get("id", "")) for c in tbl.get("cols", [])]
+    rows = []
+    for r in tbl.get("rows", []):
+        vals = [c.get("v") for c in r.get("c", [])]
+        rows.append(dict(zip(cols, vals)))
+    return rows
+
+
+def _fmt_op_table(ops: list[dict], n_top: int = 15) -> tuple[str, float]:
+    dev = [r for r in ops if r.get("Host/device") == "Device"]
+    dev.sort(key=lambda r: -float(r.get("Total self-time (us)", 0) or 0))
+    total_ms = sum(
+        float(r.get("Total self-time (us)", 0) or 0) for r in dev
+    ) / 1e3
+    lines = [
+        "| self-time (ms) | occurrences | GB/s | bound by | op |",
+        "|---|---|---|---|---|",
+    ]
+    for r in dev[:n_top]:
+        t = float(r.get("Total self-time (us)", 0) or 0) / 1e3
+        occ = int(float(r.get("#Occurrences", 0) or 0))
+        bw = float(r.get("Measured Memory BW (GBytes/Sec)", 0) or 0)
+        name = str(r.get("Operation Name", ""))
+        # Strip the jit wrapper chain for readability.
+        name = name.replace("jit(<unknown>)/", "").replace(
+            "while/body/closed_call/", ""
+        )
+        lines.append(
+            f"| {t:.2f} | {occ} | {bw:.0f} | "
+            f"{r.get('Bound by', '')} | `{name[:90]}` |"
+        )
+    return "\n".join(lines), total_ms
+
+
+def write_profile_md(cfg, param_bytes, ablations, ops, full_ms):
+    deltas = {
+        k: ablations["full"]["ms_per_step"] - v["ms_per_step"]
+        for k, v in ablations.items() if k != "full"
+    }
+    head_only_ms = (
+        ablations["no_sample"]["ms_per_step"]
+        - ablations["no_head"]["ms_per_step"]
+    )
+    abl_lines = [
+        "| variant | ms/step (marginal) | delta vs full (= component cost) |",
+        "|---|---|---|",
+        f"| full | {ablations['full']['ms_per_step']:.3f} | — |",
+    ]
+    for k, v in ablations.items():
+        if k == "full":
+            continue
+        abl_lines.append(
+            f"| {k} | {v['ms_per_step']:.3f} | {deltas[k]:+.3f} |"
+        )
+
+    # Stream floor from the actual run configuration (env-overridable).
+    max_seq = PROMPT + DECODE
+    kv_buffer_gb = 2 * cfg.n_layers * BATCH * max_seq * (
+        cfg.n_kv_heads * cfg.head_dim * 2
+    ) / 1e9
+    param_gb = param_bytes / 1e9
+    param_floor_ms = param_gb / HBM_GBPS * 1e3
+    kv_floor_ms = kv_buffer_gb / HBM_GBPS * 1e3
+    floor_ms = param_floor_ms + kv_floor_ms
+
+    op_section = "(xprof trace parse unavailable on this host)"
+    if ops:
+        tbl, total_ms = _fmt_op_table(ops)
+        op_section = (
+            f"Total device self-time in trace: {total_ms:.1f} ms "
+            f"(one `generate_fused` call: prefill + {DECODE}-step fused "
+            f"decode + host fetches).\n\n{tbl}"
+        )
+
+    md = f"""# Decode-step profile (v5e single chip)
+
+Flagship model: 1.2B llama-class bf16, batch={BATCH}, prompt={PROMPT},
+cache={PROMPT + DECODE}. Generated by `tools/profile_decode.py` on real
+hardware; see its docstring for the timing methodology (slope method —
+marginal cost per step, constant dispatch/fetch overhead cancelled).
+
+## Steady-state decode step: {full_ms:.2f} ms  (batch {BATCH} → \
+{BATCH / full_ms * 1e3:.0f} tok/s/chip)
+
+Stream floor at {HBM_GBPS:.0f} GB/s: params {param_gb:.2f} GB →
+{param_floor_ms:.2f} ms; full KV buffer read {kv_buffer_gb:.2f} GB →
+{kv_floor_ms:.2f} ms; total ≈ {floor_ms:.2f} ms/step. Measured
+{full_ms:.2f} ms = {floor_ms / full_ms * 100:.0f}% of the floor.
+
+## Ablations (slope method, each variant removes one component)
+
+{chr(10).join(abl_lines)}
+
+`no_attn` removes the cache-read einsums and softmax; `no_scatter` removes
+the post-scan KV cache write; `no_head` removes the vocab projection *and*
+sampling (its delta is head+sampling combined — head-only cost is
+t(no_sample) − t(no_head) = {head_only_ms:.3f} ms); `no_sample` replaces
+argmax/top-k/top-p with a full-logits-reduction token derivation.
+
+## Top device ops (xprof, one traced `generate_fused` call)
+
+{op_section}
+
+## Reading
+
+- The per-layer weight `dot_general`s stream at ~680 GB/s (83% of peak):
+  the scan's weight slices are prefetched into alternate memory by XLA
+  (the `S(1)` copies in the HLO) and are near the practical ceiling.
+- The `dynamic_slice` x(L·steps) at ~1300 GB/s r+w is the layer scan
+  **copying each layer's KV out of the stacked cache** before attention
+  reads it — pure overhead the Pallas decode-attention kernel removes
+  (reads the layer's KV directly from the stacked buffer).
+- IDLE in the trace is host-side gaps of `generate_fused` (tunnel fetch
+  latency ~90 ms/call on this host), not device inefficiency — the slope
+  method cancels it, `bench.py` measures the same way.
+"""
+    with open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "PROFILE.md"), "w") as f:
+        f.write(md)
 
 
 def main():
     cfg, params, mesh, engine = _build()
     prompts = _prompts(cfg)
+    param_bytes = sum(
+        np.prod(x.shape) * x.dtype.itemsize
+        for x in jax.tree.leaves(params)
+    )
 
     ablations = run_ablations(cfg, mesh, engine, prompts)
     capture_trace(engine, prompts)
     ops = parse_trace()
 
-    full = ablations.get("full")
+    full = ablations["full"]["ms_per_step"]
+    write_profile_md(cfg, param_bytes, ablations, ops, full)
     print(json.dumps({
-        "ablations_ms_per_step": {k: round(v, 3) for k, v in ablations.items()},
-        "deltas_ms": {
-            k: round(full - v, 3)
-            for k, v in ablations.items() if k != "full" and full
+        "ablations_ms_per_step": {
+            k: round(v["ms_per_step"], 3) for k, v in ablations.items()
         },
+        "deltas_ms": {
+            k: round(full - v["ms_per_step"], 3)
+            for k, v in ablations.items() if k != "full"
+        },
+        "tok_per_sec_at_full": round(BATCH / full * 1e3, 1),
         "n_trace_ops": len(ops) if ops else 0,
     }))
     if ops:
