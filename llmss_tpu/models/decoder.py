@@ -23,6 +23,7 @@ reference that are deliberate TPU-first design, not omissions:
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any
 
 import jax
@@ -233,6 +234,7 @@ def _block(
     mask: jax.Array | None,  # [B, S, T] (None in defer_write mode)
     mesh=None,
     defer_write: bool = False,
+    attn_override=None,  # (q, k_new, v_new) -> attn; stacked-cache kernel
     ablate: str | None = None,  # profiling only (tools/profile_decode.py)
 ):
     """One decoder block.
@@ -272,10 +274,13 @@ def _block(
     if ablate == "no_attn":
         attn = q  # passthrough: ablates the cache read + softmax einsums
     elif defer_write:
-        attn = fresh_kv_decode_attention(
-            q, k_cache, v_cache, k, v, positions, kv_positions, slots,
-            scale=cfg.attn_scale, window=cfg.sliding_window,
-        )
+        if attn_override is not None:
+            attn = attn_override(q, k, v)
+        else:
+            attn = fresh_kv_decode_attention(
+                q, k_cache, v_cache, k, v, positions, kv_positions, slots,
+                scale=cfg.attn_scale, window=cfg.sliding_window,
+            )
     else:
         k_cache, v_cache = write_layer(k_cache, v_cache, k, v, slots)
         attn = dispatch_attention(
@@ -298,6 +303,82 @@ def _block(
     if defer_write:
         return h, k, v  # fresh KV for the single post-scan scatter
     return h, k_cache, v_cache
+
+
+def _make_decode_kernel_attn(cfg, mesh, cache, positions, slots):
+    """Dispatch for the stacked-cache Pallas decode kernel: returns a
+    ``(q, k_new, v_new, *, layer) -> attn`` callable, else None (XLA
+    ``fresh_kv_decode_attention`` stays the implementation — also the CPU
+    oracle the kernel is parity-tested against,
+    tests/test_pallas_decode.py).
+
+    **Opt-in only** (``LLMSS_ATTN_IMPL=pallas``), never auto-dispatched:
+    measured on v5e at bench scale the kernel is *slower* than the XLA
+    einsum path (6.4 vs 4.25 ms/step) — per-call overhead across 20
+    layer invocations and strided per-head VMEM reads outweigh the
+    dynamic-slice copy it eliminates. Kept because the scalar-prefetch
+    stacked-cache read is the right building block for future paged /
+    quantized cache layouts (see PROFILE.md)."""
+    import importlib
+
+    from llmss_tpu.ops import pallas_decode
+
+    # ops/__init__ rebinds the ``attention`` attribute to the function, so
+    # the module (whose IMPL_OVERRIDE tests monkeypatch) needs importlib.
+    attention_mod = importlib.import_module("llmss_tpu.ops.attention")
+    force = attention_mod.IMPL_OVERRIDE
+    if mesh is None or force != "pallas":
+        return None
+    dp, sp, tp = (
+        mesh.shape[AXIS_DP], mesh.shape[AXIS_SP], mesh.shape[AXIS_TP]
+    )
+    B = cache.k.shape[1]
+    T, Hq, Hkv, D = cache.max_len, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    kv_shard, heads_ok, kv_ax = attention_mod.tp_head_plan(Hq, Hkv, tp)
+    local_Hq = Hq // tp
+    local_Hkv = Hkv // tp if kv_shard else Hkv
+    if sp != 1 or B % dp or not heads_ok or not pallas_decode.supports(
+        T, local_Hq, local_Hkv, D
+    ):
+        # The pallas override keeps its documented graceful fallback
+        # (prefill may still use the flash kernel while decode shapes are
+        # out of envelope) — but say so, or an A/B run silently measures
+        # the XLA path.
+        import warnings
+
+        warnings.warn(
+            "LLMSS_ATTN_IMPL=pallas: decode shapes out of the stacked-cache "
+            f"kernel envelope (sp={sp}, B={B}, dp={dp}, T={T}, Hq={Hq}, "
+            f"Hkv={Hkv}, D={D}); decode runs the XLA path",
+            stacklevel=2,
+        )
+        return None
+    qs = P(AXIS_DP, None, AXIS_TP, None)
+    ks = P(None, AXIS_DP, None, kv_ax, None)
+    kns = P(AXIS_DP, None, kv_ax, None)
+    ps = P(AXIS_DP, None)
+    interp = jax.default_backend() != "tpu"
+
+    def local(q, kc, vc, kn, vn, qp, kvp, sl, layer):
+        return pallas_decode.decode_attention(
+            q, kc, vc, kn, vn, qp, kvp, sl, layer,
+            scale=cfg.attn_scale, window=cfg.sliding_window,
+            interpret=interp,
+        )
+
+    sharded = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(qs, ks, ks, kns, kns, ps, ps, ps, P()),
+        out_specs=qs, check_vma=False,
+    )
+
+    def attn(q, k_new, v_new, *, layer):
+        return sharded(
+            q, cache.k, cache.v, k_new, v_new, positions,
+            cache.positions, slots, layer,
+        )
+
+    return attn
 
 
 def forward(
@@ -353,18 +434,40 @@ def forward(
     defer_write = S == 1 and (mesh is None or mesh.shape[AXIS_SP] == 1)
 
     if defer_write:
-        def body(h, xs):
-            bp, k_l, v_l = xs
-            h, k_f, v_f = _block(
-                cfg, bp, h, positions, k_l, v_l, cache.positions, slots,
-                None, mesh=mesh, defer_write=True, ablate=_ablate,
-            )
-            ys = None if _ablate == "no_scatter" else (k_f, v_f)
-            return h, ys
+        kernel_attn = _make_decode_kernel_attn(cfg, mesh, cache, positions,
+                                               slots)
+        if kernel_attn is not None and _ablate is None:
+            # Stacked-cache Pallas path: the scan carries only params + the
+            # layer index; the kernel's block DMAs read the layer's KV
+            # directly from the stacked buffer (no per-layer dynamic-slice
+            # copy — PROFILE.md's 0.5 ms/step sink).
+            def body(h, xs):
+                bp, layer = xs
+                h, k_f, v_f = _block(
+                    cfg, bp, h, positions, None, None, cache.positions,
+                    slots, None, mesh=mesh, defer_write=True,
+                    attn_override=partial(kernel_attn, layer=layer),
+                )
+                return h, (k_f, v_f)
 
-        h, ys = jax.lax.scan(
-            body, h, (params["blocks"], cache.k, cache.v)
-        )
+            h, ys = jax.lax.scan(
+                body, h,
+                (params["blocks"],
+                 jnp.arange(cfg.n_layers, dtype=jnp.int32)),
+            )
+        else:
+            def body(h, xs):
+                bp, k_l, v_l = xs
+                h, k_f, v_f = _block(
+                    cfg, bp, h, positions, k_l, v_l, cache.positions, slots,
+                    None, mesh=mesh, defer_write=True, ablate=_ablate,
+                )
+                ys = None if _ablate == "no_scatter" else (k_f, v_f)
+                return h, ys
+
+            h, ys = jax.lax.scan(
+                body, h, (params["blocks"], cache.k, cache.v)
+            )
         if _ablate == "no_scatter":
             k_new, v_new = cache.k, cache.v
         else:

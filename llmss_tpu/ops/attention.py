@@ -33,6 +33,21 @@ _NEG_INF = float(jnp.finfo(jnp.float32).min)
 IMPL_OVERRIDE: str | None = os.environ.get("LLMSS_ATTN_IMPL") or None
 
 
+def tp_head_plan(Hq: int, Hkv: int, tp: int) -> tuple[bool, bool, str | None]:
+    """Shared TP-shardability rule for attention heads: returns
+    ``(kv_shard, heads_ok, kv_axis)``.
+
+    Replicated-KV sharding is only correct for MQA (Hkv == 1): local head
+    grouping matches global grouping only when KV heads shard alongside
+    query heads or there is a single shared KV head.
+    """
+    from llmss_tpu.parallel.mesh import AXIS_TP
+
+    kv_shard = Hkv % tp == 0
+    heads_ok = Hq % tp == 0 and (kv_shard or Hkv == 1)
+    return kv_shard, heads_ok, AXIS_TP if kv_shard else None
+
+
 def make_causal_mask(
     q_positions: jax.Array,  # [B, S] int — absolute position of each query
     kv_positions: jax.Array,  # [B, T] int — absolute position of each cache slot
@@ -177,12 +192,7 @@ def dispatch_attention(
         dp, sp, tp = (
             mesh.shape[AXIS_DP], mesh.shape[AXIS_SP], mesh.shape[AXIS_TP]
         )
-        kv_shard = Hkv % tp == 0
-        # Replicated-KV sharding is only correct for MQA (Hkv == 1): local
-        # head grouping matches global grouping only when KV heads shard
-        # alongside query heads or there is a single shared KV head.
-        heads_ok = Hq % tp == 0 and (kv_shard or Hkv == 1)
-        kv_ax = AXIS_TP if kv_shard else None
+        kv_shard, heads_ok, kv_ax = tp_head_plan(Hq, Hkv, tp)
 
         sp_ok = (
             force in (None, "ring")

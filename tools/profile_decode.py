@@ -296,8 +296,12 @@ argmax/top-k/top-p with a full-logits-reduction token derivation.
   (the `S(1)` copies in the HLO) and are near the practical ceiling.
 - The `dynamic_slice` x(L·steps) at ~1300 GB/s r+w is the layer scan
   **copying each layer's KV out of the stacked cache** before attention
-  reads it — pure overhead the Pallas decode-attention kernel removes
-  (reads the layer's KV directly from the stacked buffer).
+  reads it — ~0.5 ms/step of pure overhead. A Pallas stacked-cache decode
+  kernel (`ops/pallas_decode.py`, scalar-prefetched layer index) removes
+  the copy but measured *slower* overall (6.4 ms/step): 20 per-layer
+  kernel invocations don't pipeline across layer boundaries the way XLA's
+  fused scan does, and the head-minor cache layout forces strided VMEM
+  reads. It stays opt-in (`LLMSS_ATTN_IMPL=pallas`), parity-tested.
 - IDLE in the trace is host-side gaps of `generate_fused` (tunnel fetch
   latency ~90 ms/call on this host), not device inefficiency — the slope
   method cancels it, `bench.py` measures the same way.
