@@ -234,7 +234,10 @@ def _block(
     mask: jax.Array | None,  # [B, S, T] (None in defer_write mode)
     mesh=None,
     defer_write: bool = False,
-    attn_override=None,  # (q, k_new, v_new) -> attn; stacked-cache kernel
+    # (q, k_new, v_new, k_cache, v_cache) -> attn; set in defer_write mode
+    # by the stacked-cache Pallas kernel (ignores the cache slices) or the
+    # sp>1 fresh-KV LSE merge (uses them).
+    attn_override=None,
     ablate: str | None = None,  # profiling only (tools/profile_decode.py)
 ):
     """One decoder block.
@@ -275,7 +278,7 @@ def _block(
         attn = q  # passthrough: ablates the cache read + softmax einsums
     elif defer_write:
         if attn_override is not None:
-            attn = attn_override(q, k, v)
+            attn = attn_override(q, k, v, k_cache, v_cache)
         else:
             attn = fresh_kv_decode_attention(
                 q, k_cache, v_cache, k, v, positions, kv_positions, slots,
@@ -372,10 +375,58 @@ def _make_decode_kernel_attn(cfg, mesh, cache, positions, slots):
         out_specs=qs, check_vma=False,
     )
 
-    def attn(q, k_new, v_new, *, layer):
+    def attn(q, k_new, v_new, k_cache, v_cache, *, layer):
+        del k_cache, v_cache  # reads the stacked cache directly
         return sharded(
             q, cache.k, cache.v, k_new, v_new, positions,
             cache.positions, slots, layer,
+        )
+
+    return attn
+
+
+def _make_sp_decode_attn(cfg, mesh, cache, positions, slots):
+    """Dispatch for sp>1 deferred-write decode: returns a
+    ``(q, k_new, v_new, k_cache, v_cache) -> attn`` callable running
+    ``lse_merge_fresh_kv_attention`` inside shard_map, or None when the
+    shapes can't ride the sp axis (caller falls back to in-scan writes +
+    the plain LSE merge, same as before)."""
+    import importlib
+
+    from llmss_tpu.ops import ring_attention as ring_mod
+
+    attention_mod = importlib.import_module("llmss_tpu.ops.attention")
+    force = attention_mod.IMPL_OVERRIDE
+    if force not in (None, "ring"):
+        return None
+    B, T = cache.k.shape[1], cache.max_len
+    ok, kv_ax = attention_mod.sp_plan(
+        mesh, B, T, cfg.n_heads, cfg.n_kv_heads
+    )
+    if not ok:
+        return None
+
+    qs = P(AXIS_DP, None, AXIS_TP, None)
+    ks = P(AXIS_DP, AXIS_SP, kv_ax, None)
+    kns = P(AXIS_DP, None, kv_ax, None)
+    ps = P(AXIS_DP, None)
+
+    def local(q, kc, vc, qp, kvp, kn, vn, sl):
+        return ring_mod.lse_merge_fresh_kv_attention(
+            q, kc, vc, qp, kvp, kn, vn, sl, axis_name=AXIS_SP,
+            scale=cfg.attn_scale, window=cfg.sliding_window,
+        )
+
+    sharded = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(qs, ks, ks, ps, P(AXIS_DP, AXIS_SP), kns, kns, ps),
+        out_specs=qs, check_vma=False,
+    )
+
+    def attn(q, k_new, v_new, k_cache, v_cache):
+        return sharded(
+            q, k_cache, v_cache, positions, cache.positions, k_new, v_new,
+            slots,
         )
 
     return attn
@@ -429,9 +480,15 @@ def forward(
     S = input_ids.shape[1]
     # Single-token decode defers all KV writes to one batched scatter after
     # the layer scan (TPU scatter cost is per-op; L in-scan scatters were
-    # ~25% of decode step time). The sp>1 path keeps in-scan writes: its
-    # sequence-sharded cache is consumed by the LSE-merge collective.
-    defer_write = S == 1 and (mesh is None or mesh.shape[AXIS_SP] == 1)
+    # ~25% of decode step time) — on sp>1 meshes too, via the fresh-KV LSE
+    # merge over the stale sequence-sharded cache (falls back to in-scan
+    # writes + plain LSE merge only when shapes can't ride the sp axis).
+    sp_attn = None
+    if S == 1 and mesh is not None and mesh.shape[AXIS_SP] > 1:
+        sp_attn = _make_sp_decode_attn(cfg, mesh, cache, positions, slots)
+    defer_write = S == 1 and (
+        mesh is None or mesh.shape[AXIS_SP] == 1 or sp_attn is not None
+    )
 
     if defer_write:
         kernel_attn = _make_decode_kernel_attn(cfg, mesh, cache, positions,
@@ -460,7 +517,8 @@ def forward(
                 bp, k_l, v_l = xs
                 h, k_f, v_f = _block(
                     cfg, bp, h, positions, k_l, v_l, cache.positions, slots,
-                    None, mesh=mesh, defer_write=True, ablate=_ablate,
+                    None, mesh=mesh, defer_write=True,
+                    attn_override=sp_attn, ablate=_ablate,
                 )
                 ys = None if _ablate == "no_scatter" else (k_f, v_f)
                 return h, ys

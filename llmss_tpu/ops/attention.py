@@ -48,6 +48,21 @@ def tp_head_plan(Hq: int, Hkv: int, tp: int) -> tuple[bool, bool, str | None]:
     return kv_shard, heads_ok, AXIS_TP if kv_shard else None
 
 
+def sp_plan(mesh, B: int, T: int, Hq: int, Hkv: int) -> tuple[bool, str | None]:
+    """Shared sp-shardability rule: whether (batch, cache length, heads) can
+    ride the mesh's sp axis. Returns ``(ok, kv_axis)``. Used by both
+    prefill/decode routing here and the deferred-write sp decode dispatch
+    (models/decoder.py) so the two can never drift."""
+    from llmss_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
+
+    dp, sp, tp = (
+        mesh.shape[AXIS_DP], mesh.shape[AXIS_SP], mesh.shape[AXIS_TP]
+    )
+    _, heads_ok, kv_ax = tp_head_plan(Hq, Hkv, tp)
+    ok = sp > 1 and T % sp == 0 and B % dp == 0 and heads_ok
+    return ok, kv_ax
+
+
 def make_causal_mask(
     q_positions: jax.Array,  # [B, S] int — absolute position of each query
     kv_positions: jax.Array,  # [B, T] int — absolute position of each cache slot
@@ -194,10 +209,8 @@ def dispatch_attention(
         )
         kv_shard, heads_ok, kv_ax = tp_head_plan(Hq, Hkv, tp)
 
-        sp_ok = (
-            force in (None, "ring")
-            and sp > 1 and T % sp == 0 and B % dp == 0 and heads_ok
-        )
+        sp_shardable, _ = sp_plan(mesh, B, T, Hq, Hkv)
+        sp_ok = force in (None, "ring") and sp_shardable
         if force == "ring" and not sp_ok:
             # A silent fallback would make an A/B run measure the wrong
             # implementation; forcing ring demands a satisfiable sp mesh.

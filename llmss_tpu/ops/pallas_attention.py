@@ -95,8 +95,11 @@ def _kernel(
         l_prev = l_ref[:, :1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_next = jnp.maximum(m_prev, m_cur)
-        # Masked lanes sit at _NEG_INF (finite), so exp underflows to 0
-        # without NaN even for all-masked rows.
+        # Masked lanes hold finite _NEG_INF: exp underflows to 0 against
+        # any real max. A row whose every lane is masked in a *live* block
+        # has m_next == _NEG_INF, so p = exp(0) = 1 and the row degrades to
+        # the uniform average — same as the XLA path's finite-min masking
+        # (and ring_attention.py's identical accumulation).
         p = jnp.exp(s - m_next)  # [bq, bk] f32
         alpha = jnp.exp(m_prev - m_next)  # [bq, 1]
         l_ref[:, :1] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)

@@ -36,11 +36,15 @@ from jax import lax
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
-def _online_block(m, l, acc, q, k, v, q_pos, kv_pos, scale, window=None):
+def _online_block(m, l, acc, q, k, v, q_pos, kv_pos, scale, window=None,
+                  exclude=None):
     """One online-softmax accumulation step of grouped-query attention.
 
     State shapes: m/l [B, Hkv, G, S], acc [B, Hkv, G, S, D] (fp32).
     q [B, S, Hq, D]; k/v [B, C, Hkv, D] — the current KV chunk.
+    ``exclude`` [B, C] bool marks chunk slots to mask out regardless of
+    position (the deferred-write decode path excludes the slot the
+    incoming token will overwrite).
     """
     B, S, Hq, D = q.shape
     Hkv = k.shape[2]
@@ -52,6 +56,8 @@ def _online_block(m, l, acc, q, k, v, q_pos, kv_pos, scale, window=None):
     )  # [B, S, C]
     if window is not None:
         mask &= kv_pos[:, None, :] > q_pos[:, :, None] - window
+    if exclude is not None:
+        mask &= ~exclude[:, None, :]
     s = jnp.where(mask[:, None, None], s, _NEG_INF)
 
     m_cur = jnp.max(s, axis=-1)
@@ -145,3 +151,65 @@ def lse_merge_attention(
     l_g = lax.psum(l * w, axis_name)
     acc_g = lax.psum(acc * w[..., None], axis_name)
     return _finish(m_g, l_g, acc_g, q)
+
+
+def lse_merge_fresh_kv_attention(
+    q: jax.Array,  # [B, 1, Hq, D] — replicated over sp
+    k: jax.Array,  # [B, C, Hkv, D] — local *stale* KV chunk
+    v: jax.Array,
+    q_pos: jax.Array,  # [B, 1] — replicated
+    kv_pos: jax.Array,  # [B, C] — local chunk positions, pre-write
+    k_new: jax.Array,  # [B, 1, Hkv, D] — current token's KV, replicated
+    v_new: jax.Array,
+    slots: jax.Array,  # [B, 1] — *global* ring slot the token will occupy
+    *,
+    axis_name: str,
+    scale: float | None = None,
+    window: int | None = None,
+) -> jax.Array:
+    """Split-KV decode attention over a **stale** sp-sharded cache with the
+    fresh current-token KV merged into the same softmax — the sp>1 analogue
+    of ``ops.attention.fresh_kv_decode_attention``, enabling the decode
+    loop's deferred-write scatter on sequence-parallel meshes too.
+
+    Each shard masks out the pending slot if it owns it (matching the
+    write-then-attend order of the in-scan path on ring wrap), partials
+    merge with the LSE-weighted psum, then every shard merges the identical
+    replicated fresh-KV term — outputs stay replicated with no extra
+    collective. Must run inside ``shard_map`` with ``axis_name`` mapped.
+    """
+    B, S, Hq, D = q.shape
+    C = k.shape[1]
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    if scale is None:
+        scale = 1.0 / (D**0.5)
+
+    start = lax.axis_index(axis_name) * C
+    slot_idx = start + jnp.arange(C, dtype=jnp.int32)
+    exclude = slot_idx[None, :] == slots  # [B, C] (slots [B,1] broadcasts)
+
+    m0, l0, acc0 = _init_state(q, Hkv)
+    m, l, acc = _online_block(
+        m0, l0, acc0, q, k, v, q_pos, kv_pos, scale, window, exclude=exclude
+    )
+    m_g = lax.pmax(m, axis_name)
+    w = jnp.exp(m - m_g)
+    l_g = lax.psum(l * w, axis_name)
+    acc_g = lax.psum(acc * w[..., None], axis_name)
+
+    # Fresh-token term (same math as fresh_kv_decode_attention's s_s /
+    # pallas_decode's epilogue): the token always attends itself, so an
+    # empty cache degenerates to out = v_new with no l == 0 guard.
+    qf = q.astype(jnp.float32).reshape(B, S, Hkv, G, D) * scale
+    s_new = jnp.einsum(
+        "bskgd,bskd->bkgs", qf, k_new.astype(jnp.float32)
+    )  # [B, Hkv, G, S]
+    m_f = jnp.maximum(m_g, s_new)
+    alpha = jnp.exp(m_g - m_f)
+    p_new = jnp.exp(s_new - m_f)
+    l_f = l_g * alpha + p_new
+    acc_f = acc_g * alpha[..., None] + p_new[..., None] * v_new.astype(
+        jnp.float32
+    ).transpose(0, 2, 1, 3)[:, :, None]
+    return _finish(m_f, l_f, acc_f, q)

@@ -69,13 +69,19 @@ def sample(
         cum_before = jnp.cumsum(probs, axis=-1) - probs
         rank = jnp.arange(V, dtype=jnp.int32)[None, :]
         k_eff = jnp.where(top_k <= 0, V, top_k).astype(jnp.int32)[:, None]
-        keep = (rank < k_eff) & (cum_before < top_p[:, None])
-        keep = keep.at[:, 0].set(True)
-        filtered = jnp.where(keep, svals, float(jnp.finfo(jnp.float32).min))
-        choice = categorical_rows(keys, filtered)
-        return jnp.take_along_axis(
-            order, choice[:, None], axis=-1
-        )[:, 0].astype(jnp.int32)
+        keep_sorted = (rank < k_eff) & (cum_before < top_p[:, None])
+        keep_sorted = keep_sorted.at[:, 0].set(True)
+        # Scatter the keep set back to token order and draw there, so the
+        # Gumbel noise pairs with token ids, not sorted ranks: the same
+        # (seed, counter) yields the same token whether or not any other
+        # row of the batch uses a warper (_plain_sample is then exactly the
+        # keep-everything degenerate case of this draw).
+        rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+        keep = jnp.zeros((B, V), bool).at[rows, order].set(keep_sorted)
+        filtered = jnp.where(
+            keep, scaled, float(jnp.finfo(jnp.float32).min)
+        )
+        return categorical_rows(keys, filtered).astype(jnp.int32)
 
     def _plain_sample() -> jax.Array:
         # No top-k/top-p anywhere in the batch: categorical over the

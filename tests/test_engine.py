@@ -93,6 +93,16 @@ def test_per_request_seed_honored(engine):
     solo = engine.generate([prompt], mk(1))
     assert solo[0] == outs[1]
 
+    # ...including when another row's warpers route the batch through the
+    # sorted-filter path: the warper-free row's realization must not change
+    # (the filtered draw happens in token order, ops/sampling.py).
+    warped = GenerationParams(
+        max_new_tokens=8, is_greedy=False, temperature=0.7, top_k=5,
+        top_p=0.8, seed=9,
+    )
+    mixed = engine.generate([prompt, prompt], [mk(1), warped])
+    assert mixed[0] == solo[0]
+
 
 def test_ring_buffer_overflow(tiny_gptj, devices):
     """Generation past max_seq_len slides the window (≙ SURVEY §2.11.2)
