@@ -69,7 +69,12 @@ def sample(
         cum_before = jnp.cumsum(probs, axis=-1) - probs
         rank = jnp.arange(V, dtype=jnp.int32)[None, :]
         k_eff = jnp.where(top_k <= 0, V, top_k).astype(jnp.int32)[:, None]
-        keep_sorted = (rank < k_eff) & (cum_before < top_p[:, None])
+        # top_p >= 1.0 means disabled: compare against 2.0 so fp32 cumsum
+        # rounding (cum_before hitting exactly 1.0 at a tail token) can
+        # never mask a token a plain categorical could draw — keeping the
+        # keep-everything case *exactly* equal to _plain_sample.
+        p_eff = jnp.where(top_p >= 1.0, 2.0, top_p)[:, None]
+        keep_sorted = (rank < k_eff) & (cum_before < p_eff)
         keep_sorted = keep_sorted.at[:, 0].set(True)
         # Scatter the keep set back to token order and draw there, so the
         # Gumbel noise pairs with token ids, not sorted ranks: the same
