@@ -1,0 +1,175 @@
+"""Serving-path benchmark: ContinuousWorker under Poisson arrivals.
+
+BASELINE.md configs #4/#5 analogue at single-chip scale — the serving stack
+(broker → continuous batcher → engine) measured under load, not just the
+bare engine loop that ``bench.py`` times. Prints ONE JSON line:
+
+    {"metric": "serve_tokens_per_sec_per_chip", "value": N,
+     "unit": "... p50/p95 TTFT + e2e latency ...", "vs_baseline": N}
+
+``vs_baseline`` uses the same HBM-roofline definition as ``bench.py`` at
+the worker's row count, so the two lines are directly comparable: the gap
+between them is the price of serving (per-step host sync for token
+delivery, batch-1 admission prefills, scheduling) on top of raw decode.
+On the axon bench host that price is inflated by ~1.5 ms/step of tunnel
+fetch latency for the per-token host sync — a host-link artifact a local
+deployment does not pay (see PROFILE.md's methodology note).
+
+Load model: Poisson arrivals (seeded) of 128-token random prompts, 128
+greedy new tokens each, at ``SERVE_RATE`` req/s for ``SERVE_SECONDS``;
+TTFT comes from the engine's prefill stats, end-to-end latency from the
+client side. Writes the full result to ``SERVE_BENCH.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+
+import jax
+import numpy as np
+
+from bench import BATCH, DECODE, HBM_GBPS, PROMPT, flagship_cfg
+
+RATE = float(os.environ.get("SERVE_RATE", 24.0))  # requests/sec
+SECONDS = float(os.environ.get("SERVE_SECONDS", 30.0))
+ROWS = int(os.environ.get("SERVE_ROWS", 32))
+
+
+def main():
+    from llmss_tpu.engine import DecodeEngine
+    from llmss_tpu.models.decoder import init_params
+    from llmss_tpu.parallel import MeshPlan, make_mesh
+    from llmss_tpu.serve.broker import InProcBroker
+    from llmss_tpu.serve.consumer import ContinuousWorker
+    from llmss_tpu.serve.protocol import GenerateRequest
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshPlan(tp=n_dev))
+    cfg = flagship_cfg()
+    params = init_params(cfg, mesh, jax.random.key(0))
+    n_params = sum(np.prod(x.shape) for x in jax.tree.leaves(params))
+    param_bytes = float(n_params) * 2
+    max_seq = PROMPT + DECODE
+    engine = DecodeEngine(cfg, params, mesh, max_seq_len=max_seq)
+    broker = InProcBroker()
+    worker = ContinuousWorker(
+        engine, broker, tokenizer=None, rows=ROWS,
+        chunk_steps=int(os.environ.get("SERVE_CHUNK", 32)),
+    )
+
+    rng = np.random.default_rng(0)
+
+    def make_req():
+        return GenerateRequest(
+            id=uuid.uuid4().hex,
+            token_ids=rng.integers(0, cfg.vocab_size, PROMPT).tolist(),
+            max_new_tokens=DECODE,
+            is_greedy=True,
+        )
+
+    # -- warmup: compile the full serving envelope for this load shape ----
+    t0 = time.time()
+    n_exec = worker.prewarm(seq_buckets=[PROMPT])
+    print(f"# prewarmed {n_exec} executables in {time.time() - t0:.0f}s")
+    warm_ids = []
+    for _ in range(ROWS):
+        r = make_req()
+        warm_ids.append(r.id)
+        broker.push_request(r)
+    deadline = time.time() + 300
+    while warm_ids and time.time() < deadline:
+        worker.run_once()
+        warm_ids = [
+            i for i in warm_ids
+            if broker.wait_response(i, timeout=0.001) is None
+        ]
+    assert not warm_ids, "warmup did not complete"
+
+    # -- load phase --------------------------------------------------------
+    lat: dict[str, float] = {}
+    lat_lock = threading.Lock()
+    submitted = []
+    stop_client = threading.Event()
+
+    def waiter(req_id: str, t_submit: float):
+        resp = broker.wait_response(req_id, timeout=SECONDS * 3 + 120)
+        if resp is not None and resp.error is None:
+            with lat_lock:
+                lat[req_id] = time.time() - t_submit
+
+    def client():
+        arr_rng = np.random.default_rng(7)
+        t_end = time.time() + SECONDS
+        while time.time() < t_end and not stop_client.is_set():
+            time.sleep(arr_rng.exponential(1.0 / RATE))
+            req = make_req()
+            t0 = time.time()
+            broker.push_request(req)
+            submitted.append(req.id)
+            threading.Thread(
+                target=waiter, args=(req.id, t0), daemon=True
+            ).start()
+
+    # Reset metrics so the report covers only the measured window.
+    from llmss_tpu.utils.metrics import EngineMetrics
+
+    engine.metrics = EngineMetrics()
+
+    ct = threading.Thread(target=client, daemon=True)
+    t_start = time.time()
+    ct.start()
+    # Worker loop on the main thread until the client stops and the batch
+    # drains.
+    while ct.is_alive() or not worker.batcher.idle:
+        worker.run_once()
+        if time.time() - t_start > SECONDS * 3 + 240:
+            stop_client.set()
+            break
+    t_wall = time.time() - t_start
+
+    m = engine.metrics.to_dict()
+    done = len(lat)
+    lat_sorted = sorted(lat.values())
+
+    def pct(q):
+        return (
+            round(lat_sorted[min(int(q / 100 * len(lat_sorted)),
+                                 len(lat_sorted) - 1)], 2)
+            if lat_sorted else None
+        )
+
+    toks = m["tokens_generated"]
+    serve_tps = toks / t_wall / n_dev
+
+    kv_bytes_per_token = (
+        2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * 2 * max_seq / 2
+    )
+    roofline = ROWS * HBM_GBPS * 1e9 / (
+        param_bytes + ROWS * kv_bytes_per_token
+    )
+
+    result = {
+        "metric": "serve_tokens_per_sec_per_chip",
+        "value": round(serve_tps, 1),
+        "unit": (
+            f"tok/s/chip (1.2B bf16, continuous batching rows={ROWS}, "
+            f"poisson {RATE} req/s x {SECONDS:.0f}s, {done}/"
+            f"{len(submitted)} served, ttft_p50={m['ttft']['p50_ms']}ms "
+            f"p95={m['ttft']['p95_ms']}ms, e2e_p50={pct(50)}s "
+            f"p95={pct(95)}s, decode_step_p50="
+            f"{m['decode_step']['p50_ms']}ms)"
+        ),
+        "vs_baseline": round(serve_tps / roofline, 3),
+    }
+    print(json.dumps(result))
+    with open("SERVE_BENCH.json", "w") as f:
+        json.dump({**result, "raw_metrics": m, "wall_s": round(t_wall, 1)},
+                  f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

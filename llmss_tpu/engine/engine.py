@@ -182,6 +182,59 @@ class DecodeEngine:
         self.metrics.add_request(batch)
         return out
 
+    def seq_buckets(self) -> list[int]:
+        """Every prompt bucket _pad_prompts can produce for this engine."""
+        out, b = [], 16
+        while b < self.max_seq_len:
+            out.append(b)
+            b *= 2
+        out.append(self.max_seq_len)
+        return out
+
+    def prewarm(
+        self, batch: int, *, chunk_steps: tuple[int, ...] | int = (),
+    ) -> int:
+        """Compile every executable the serving path can hit at ``batch``:
+        prefill for each seq bucket, the single-token decode step, and the
+        fused chunk scans. Eats the multi-second XLA compiles at worker
+        startup instead of on the first unlucky request
+        (engine/engine.py's bucketing bounds the set to log₂ buckets).
+        Returns the number of executables compiled."""
+        if isinstance(chunk_steps, int):
+            chunk_steps = (chunk_steps,)
+        sa = self._sample_args(GenerationParams(), batch)
+        n = 0
+        for S in self.seq_buckets():
+            cache = self.new_cache(batch)
+            ids = jnp.zeros((batch, S), jnp.int32)
+            lens = jnp.ones(batch, jnp.int32)
+            tok, _, cache = self._prefill(self.params, ids, cache, lens, sa)
+            del cache
+            n += 1
+        cache = self.new_cache(batch)
+        cur = jnp.ones(batch, jnp.int32)
+        # Each cache-consuming executable is called twice: the cache's
+        # PartitionSpec representation alternates between two normalized
+        # forms as it cycles through jit outputs (trailing-None stripped /
+        # re-added), giving every such executable two steady-state input
+        # signatures — both must be compiled or the second real call still
+        # stalls.
+        for _ in range(2):
+            tok, _, cache = self._decode(self.params, tok, cache, cur, sa)
+            n += 1
+        for k in chunk_steps:
+            if k <= 1:
+                continue
+            done = jnp.zeros(batch, bool)
+            for _ in range(2):
+                _, cache, _, _ = self._decode_many(
+                    self.params, tok, cache, cur, sa, done,
+                    jnp.full(batch, -1, jnp.int32), n_steps=k,
+                )
+                n += 1
+        del cache
+        return n
+
     def new_cache(self, batch: int | None = None) -> KVCache:
         return init_cache(
             self.mesh,
@@ -229,6 +282,8 @@ class DecodeEngine:
         *,
         on_token=None,
         cancel_poll=None,
+        chunk_steps: int = 1,
+        live_rows: int | None = None,
     ) -> list[list[int]]:
         """Streaming host-loop generation (≙ generate.py:99-145 cache path).
 
@@ -237,11 +292,26 @@ class DecodeEngine:
         (the serving path; the reference hard-codes one config per batch).
         ``on_token(step, tokens: np.ndarray)`` is called per step — the
         serving layer streams from here. Stops early when every row is done.
-        ``cancel_poll() -> iterable[int]`` (optional) is polled each step for
-        row indices whose clients went away: those rows stop accumulating
-        tokens and count as done (so an all-cancelled batch stops decoding
-        within one step).
+        ``cancel_poll() -> iterable[int]`` (optional) is polled for row
+        indices whose clients went away: those rows stop accumulating
+        tokens and count as done.
+
+        ``chunk_steps > 1`` runs that many fused decode steps per host
+        round-trip (one dispatch + one token fetch per chunk instead of per
+        token): the serving throughput lever — host-link latency amortizes
+        across the chunk. Token *results* are identical; the trade is
+        granularity: ``on_token``/``cancel_poll`` fire once per chunk, and
+        a row reaching EOS mid-chunk stops contributing but the chunk still
+        runs to its end on device (its extra steps produce discarded EOS
+        fills — same cost the single-step path pays keeping done rows in
+        the batch).
+
+        ``live_rows`` marks how many leading rows are real requests when
+        the caller padded the batch to its envelope (serving): metrics
+        count only those, and only their tokens.
         """
+        if chunk_steps < 1:
+            raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
         B = len(prompts)
         gens = gen if isinstance(gen, list) else [gen] * B
         assert len(gens) == B
@@ -253,7 +323,7 @@ class DecodeEngine:
 
         tok, _, cache = self.timed_prefill(
             self._prefill, self.params, jnp.asarray(ids), cache,
-            jnp.asarray(lens), sample_args, batch=B,
+            jnp.asarray(lens), sample_args, batch=live_rows or B,
         )
         eos = np.asarray(
             [g.eos_token_id if g.eos_token_id is not None else -1
@@ -264,34 +334,67 @@ class DecodeEngine:
         done = np.zeros(B, bool)
         cur_pos = jnp.asarray(lens)
         total_steps = int(max_new.max())
+        eos_dev = jnp.asarray(eos, jnp.int32)
 
-        for step in range(total_steps):
-            if cancel_poll is not None:
-                for i in cancel_poll():
-                    done[i] = True
-            tok_np = np.asarray(tok)
+        step = 0
+
+        def process(tok_np) -> bool:
+            """Account one step's tokens; returns True when all rows done."""
+            nonlocal step
             newly_done = (tok_np == eos) | (step >= max_new)
             for i in range(B):
                 if not done[i] and not newly_done[i]:
                     out[i].append(int(tok_np[i]))
                     if len(out[i]) == max_new[i]:
                         done[i] = True
-            done |= newly_done
+            done[:] = done | newly_done
             if on_token is not None:
                 on_token(step, tok_np)
-            if done.all() or step == total_steps - 1:
-                break
-            with self.metrics.decode_step.time():
-                tok, _, cache = self._decode(
-                    self.params, tok, cache, cur_pos, sample_args
+            step += 1
+            return bool(done.all())
+
+        process(np.asarray(tok))
+        while not done.all() and step < total_steps:
+            if cancel_poll is not None:
+                for i in cancel_poll():
+                    done[i] = True
+                if done.all():
+                    break
+            # Always run full chunks (never a remainder-sized one): a
+            # distinct n_steps would compile a fresh executable mid-request.
+            # Overshoot columns are discarded by process() — once step
+            # reaches every row's max_new, all rows are done and the loop
+            # exits.
+            k = chunk_steps
+            if k == 1:
+                with self.metrics.decode_step.time():
+                    tok, _, cache = self._decode(
+                        self.params, tok, cache, cur_pos, sample_args
+                    )
+                    # Sync inside the timer: dispatch is async, so without
+                    # this the stat would record ~µs dispatch overhead, not
+                    # step latency. The loop reads the token next iteration
+                    # anyway, so this costs nothing.
+                    tok.block_until_ready()
+                cur_pos = cur_pos + 1
+                process(np.asarray(tok))
+            else:
+                t0 = time.perf_counter()
+                toks, cache, cur_pos, _ = self._decode_many(
+                    self.params, tok, cache, cur_pos, sample_args,
+                    jnp.asarray(done), eos_dev, n_steps=k,
                 )
-                # Sync inside the timer: dispatch is async, so without this
-                # the stat would record ~µs dispatch overhead, not step
-                # latency. The loop reads the token next iteration anyway,
-                # so this costs nothing.
-                tok.block_until_ready()
-            cur_pos = cur_pos + 1
-        self.metrics.add_tokens(sum(len(o) for o in out))
+                chunk_np = np.asarray(toks)  # [B, k] — the real host sync
+                self.metrics.decode_step.record(
+                    (time.perf_counter() - t0) / k
+                )
+                tok = toks[:, -1]
+                for col in range(k):
+                    if process(chunk_np[:, col]):
+                        break
+        self.metrics.add_tokens(
+            sum(len(o) for o in out[: live_rows or B])
+        )
         return out
 
     def generate_fused(

@@ -39,13 +39,38 @@ class _Row:
     gen: GenerationParams
     out: list[int]
     cur_pos: int
-    done_cb: Callable[[list[int]], None]
+    # Called as done_cb(tokens) on completion, done_cb(tokens, True) when
+    # the request was cancelled (tokens = what was produced before the
+    # cancel) — so the serving layer can answer honestly instead of
+    # disguising a cancelled request as a success.
+    done_cb: Callable[..., None]
+
+
+@dataclasses.dataclass
+class _InFlightAdmission:
+    """An admission batch whose prefill + insert are dispatched but whose
+    first tokens have not been fetched: resolved (rows activated) at the
+    top of the next step, overlapping admission with the decode chunk."""
+
+    taken: list  # [(req_id, ids, gen, cb)]
+    rows: list[int]
+    tok: jax.Array  # [P] first sampled token per admission row (device)
+    t0: float  # dispatch wall-clock, for TTFT accounting
 
 
 class ContinuousBatcher:
-    def __init__(self, engine: DecodeEngine, *, rows: int = 8):
+    def __init__(
+        self, engine: DecodeEngine, *, rows: int = 8, chunk_steps: int = 1
+    ):
+        # chunk_steps > 1 advances all rows that many tokens per host
+        # round-trip (one fused scan + one fetch instead of per-token
+        # sync) — the serving throughput lever; admission/finish/cancel
+        # granularity becomes the chunk instead of the single token.
+        if chunk_steps < 1:
+            raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
         self.engine = engine
         self.rows = rows
+        self.chunk_steps = chunk_steps
         self.cache = engine.new_cache(rows)
         self._scratch_template = None
         self.pending: deque = deque()
@@ -54,6 +79,8 @@ class ContinuousBatcher:
         self._tokens = np.zeros(rows, np.int32)
         self._step_count = 0
         self._cancelled: set[str] = set()
+        self._inflight: _InFlightAdmission | None = None
+        self._cancel_at_resolve: set[str] = set()
         self._lock = threading.Lock()
 
         cfg = engine.cfg
@@ -64,12 +91,85 @@ class ContinuousBatcher:
         )
 
     @staticmethod
-    def _insert_impl(big: KVCache, small: KVCache, row) -> KVCache:
+    def _insert_impl(big: KVCache, small: KVCache, rows) -> KVCache:
+        """Copy scratch-cache rows into the persistent cache at ``rows``
+        ([P] int32; -1 entries are padding and dropped)."""
         return KVCache(
-            k=big.k.at[:, row].set(small.k[:, 0]),
-            v=big.v.at[:, row].set(small.v[:, 0]),
-            positions=big.positions.at[row].set(small.positions[0]),
+            k=big.k.at[:, rows].set(small.k, mode="drop"),
+            v=big.v.at[:, rows].set(small.v, mode="drop"),
+            positions=big.positions.at[rows].set(
+                small.positions, mode="drop"
+            ),
         )
+
+    def prewarm(self, seq_buckets: list[int] | None = None) -> int:
+        """Compile every executable the scheduler can hit: admission
+        prefill for each (admission-batch P, seq bucket S) pair, the row
+        insert per P, and the decode step/chunk at the full row count —
+        so no request ever eats a multi-second XLA compile mid-serve.
+        ``seq_buckets`` narrows the prompt-length envelope when known
+        (default: every bucket up to the engine's max_seq_len). Returns
+        the number of executables compiled."""
+        eng = self.engine
+        if seq_buckets is None:
+            seq_buckets = eng.seq_buckets()
+        Ps, p = [], 1
+        while p < self.rows:
+            Ps.append(p)
+            p *= 2
+        Ps.append(p)  # one above, for n == rows when rows isn't a pow2
+        n_compiled = 0
+        for P in sorted(set(Ps)):
+            sa = eng._sample_args(GenerationParams(), P)
+            scratch = None
+            for S in seq_buckets:
+                scratch = eng.new_cache(P)
+                ids = jnp.zeros((P, S), np.int32)
+                lens = jnp.ones(P, np.int32)
+                _tok, _, scratch = self._prefill_row(
+                    eng.params, ids, scratch, jnp.asarray(lens), sa,
+                )
+                n_compiled += 1
+            # Insert with all-dropped indices: compiles the P-shaped
+            # scatter without touching live rows. Twice, because the
+            # cache's PartitionSpec representation alternates between two
+            # normalized forms as it cycles through jit outputs — each
+            # cache-consuming executable has two steady-state signatures.
+            for _ in range(2):
+                self.cache = self._insert(
+                    self.cache, scratch, jnp.full(P, -1, np.int32)
+                )
+                n_compiled += 1
+        # Decode step/chunk at the full row count (twice — see above).
+        sa = eng._sample_args(GenerationParams(), self.rows)
+        cur = jnp.zeros(self.rows, np.int32)
+        toks = jnp.zeros(self.rows, np.int32)
+        for _ in range(2):
+            if self.chunk_steps > 1:
+                _t, self.cache, _, _ = eng._decode_many(
+                    eng.params, toks, self.cache, cur, sa,
+                    jnp.ones(self.rows, bool),
+                    jnp.full(self.rows, -1, np.int32),
+                    n_steps=self.chunk_steps,
+                )
+            else:
+                _t, _, self.cache = eng._decode(
+                    eng.params, toks, self.cache, cur, sa
+                )
+            n_compiled += 1
+        # The prewarm decode ran with every row marked done/free, but its
+        # cache writes still landed — reset positions so no ghost slots
+        # survive into real serving. device_put with the original sharding:
+        # an eager op could re-commit the array and key fresh compiles for
+        # every executable that takes the cache.
+        self.cache = KVCache(
+            k=self.cache.k, v=self.cache.v,
+            positions=jax.device_put(
+                jnp.full_like(self.cache.positions, -1),
+                self.cache.positions.sharding,
+            ),
+        )
+        return n_compiled
 
     # -- submission ---------------------------------------------------------
 
@@ -86,44 +186,110 @@ class ContinuousBatcher:
 
     # -- scheduling ---------------------------------------------------------
 
-    def _admit_one(self) -> bool:
+    def _admit_dispatch(self) -> _InFlightAdmission | None:
+        """Dispatch admission for every pending request that has a free
+        row: ONE batched prefill + ONE row-scatter insert, **no blocking
+        fetch** — the first tokens are read by ``_resolve_admission`` at
+        the top of the next step, so admission compute and its device→host
+        round-trip overlap the decode chunk instead of serializing behind
+        it (per-request admission measured ~0.2 s over the bench host's
+        tunnel; batched + overlapped it disappears from the critical path).
+
+        Must be called *after* the step's decode is dispatched: device
+        programs run in dispatch order, so the insert lands between this
+        chunk and the next — the chunk can't scribble on freshly inserted
+        rows (done rows still write their cache slot), and the next chunk
+        sees them.
+
+        The admission batch pads to a power of two (dummy rows) so the
+        compile envelope stays (log₂ rows × log₂ seq buckets) executables.
+        """
         with self._lock:
-            if not self.pending or not self._free:
-                return False
-            req_id, ids, gen, cb = self.pending.popleft()
-            row = self._free.pop()
+            n = min(len(self.pending), len(self._free))
+            if n == 0:
+                return None
+            taken = [self.pending.popleft() for _ in range(n)]
+            rows = [self._free.pop() for _ in range(n)]
 
-        S = _bucket(len(ids), self.engine.max_seq_len)
-        padded = np.zeros((1, S), np.int32)
-        padded[0, : len(ids)] = ids
-        scratch = self.engine.new_cache(1)
-        sample_args = self.engine._sample_args(gen, 1)
-        tok, _, scratch = self.engine.timed_prefill(
-            self._prefill_row, self.engine.params, jnp.asarray(padded),
-            scratch, jnp.asarray([len(ids)], jnp.int32), sample_args,
-            batch=1,
+        P = 1
+        while P < n:
+            P *= 2
+        S = _bucket(
+            max(len(ids) for _rid, ids, _g, _cb in taken),
+            self.engine.max_seq_len,
         )
-        self.cache = self._insert(self.cache, scratch, jnp.int32(row))
+        padded = np.zeros((P, S), np.int32)
+        lens = np.ones(P, np.int32)  # dummy rows prefill one pad token
+        gens = []
+        for i, (_rid, ids, gen, _cb) in enumerate(taken):
+            padded[i, : len(ids)] = ids
+            lens[i] = len(ids)
+            gens.append(gen)
+        gens += [GenerationParams()] * (P - n)
+        row_idx = np.full(P, -1, np.int32)  # -1 = dropped by the scatter
+        row_idx[:n] = rows
 
-        first = int(np.asarray(tok)[0])
-        r = _Row(req_id=req_id, gen=gen, out=[], cur_pos=len(ids), done_cb=cb)
-        eos = gen.eos_token_id if gen.eos_token_id is not None else -1
-        if first == eos or gen.max_new_tokens == 0:
-            self._finish(row, r)
-            return True
-        r.out.append(first)
-        self.engine.metrics.add_tokens(1)
-        self._tokens[row] = first
-        self.active[row] = r
-        if len(r.out) >= r.gen.max_new_tokens:
-            self._finish(row, r)
-        return True
+        t0 = time.perf_counter()
+        scratch = self.engine.new_cache(P)
+        sample_args = self.engine._sample_args(gens, P)
+        tok, _, scratch = self._prefill_row(
+            self.engine.params, jnp.asarray(padded), scratch,
+            jnp.asarray(lens), sample_args,
+        )
+        self.cache = self._insert(
+            self.cache, scratch, jnp.asarray(row_idx)
+        )
+        return _InFlightAdmission(taken=taken, rows=rows, tok=tok, t0=t0)
 
-    def _finish(self, row: int, r: _Row) -> None:
+    def _resolve_admission(self) -> int:
+        """Activate the previously dispatched admission batch (fetch its
+        first tokens — by now overlapped with the last decode chunk)."""
+        adm, self._inflight = self._inflight, None
+        if adm is None:
+            return 0
+        firsts = np.asarray(adm.tok)
+        # dt spans dispatch → resolve, i.e. includes the decode chunk the
+        # admission deliberately overlapped — the honest time-to-first-
+        # token. It is NOT recorded as prefill latency (the prefill stat
+        # stays a tight measure of prefill compute on the non-overlapped
+        # paths; recording dt there would inflate it by a chunk).
+        dt = time.perf_counter() - adm.t0
+        for _ in adm.taken:
+            self.engine.metrics.ttft.record(dt)
+        self.engine.metrics.add_request(len(adm.taken))
+
+        cancelled = self._cancel_at_resolve
+        self._cancel_at_resolve = set()
+        for i, (req_id, ids, gen, cb) in enumerate(adm.taken):
+            row = adm.rows[i]
+            r = _Row(
+                req_id=req_id, gen=gen, out=[], cur_pos=len(ids), done_cb=cb
+            )
+            if req_id in cancelled:
+                self.engine.metrics.add_cancelled(1)
+                self._finish(row, r, cancelled=True)
+                continue
+            first = int(firsts[i])
+            eos = gen.eos_token_id if gen.eos_token_id is not None else -1
+            if first == eos or gen.max_new_tokens == 0:
+                self._finish(row, r)
+                continue
+            r.out.append(first)
+            self.engine.metrics.add_tokens(1)
+            self._tokens[row] = first
+            self.active[row] = r
+            if len(r.out) >= r.gen.max_new_tokens:
+                self._finish(row, r)
+        return len(adm.taken)
+
+    def _finish(self, row: int, r: _Row, cancelled: bool = False) -> None:
         self.active.pop(row, None)
         with self._lock:
             self._free.append(row)
-        r.done_cb(r.out)
+        if cancelled:
+            r.done_cb(r.out, True)
+        else:
+            r.done_cb(r.out)
 
     def cancel(self, req_id: str) -> None:
         """Mark a request cancelled (thread-safe). The worker thread frees
@@ -135,26 +301,49 @@ class ContinuousBatcher:
 
     def _process_cancellations(self) -> int:
         """Worker-thread half of ``cancel``: drop marked pending requests
-        and free marked active rows."""
+        (their callbacks fire with ``cancelled=True`` so every submitted
+        request gets exactly one response), free marked active rows, and
+        mark in-flight admissions for drop at resolve. Unmatched ids are
+        discarded — the broker-side cancellation flag persists (TTL'd), so
+        a cancel racing ahead of its request is re-delivered by the
+        worker's ``check_cancelled`` once the request shows up."""
         with self._lock:
             if not self._cancelled:
                 return 0
             ids, self._cancelled = self._cancelled, set()
-            kept = deque(p for p in self.pending if p[0] not in ids)
-            n = len(self.pending) - len(kept)
-            self.pending = kept
+            dropped = [p for p in self.pending if p[0] in ids]
+            self.pending = deque(p for p in self.pending if p[0] not in ids)
+        n = len(dropped)
+        for _rid, _ids, _gen, cb in dropped:
+            cb([], True)
+        if self._inflight is not None:
+            for req_id, *_rest in self._inflight.taken:
+                if req_id in ids:
+                    # metrics counted at resolve, where the row frees
+                    self._cancel_at_resolve.add(req_id)
         for row, r in list(self.active.items()):
             if r.req_id in ids:
-                self._finish(row, r)
+                self._finish(row, r, cancelled=True)
                 n += 1
         if n:
             self.engine.metrics.add_cancelled(n)
         return n
 
+    def live_ids(self) -> list[str]:
+        """Every request id this batcher currently owns (pending, in-flight
+        admission, active) — what the worker polls cancellation flags for."""
+        with self._lock:
+            ids = [req_id for (req_id, *_r) in self.pending]
+        if self._inflight is not None:
+            ids += [req_id for (req_id, *_r) in self._inflight.taken]
+        ids += [r.req_id for r in self.active.values()]
+        return ids
+
     def drain_all(self) -> list[str]:
-        """Remove every pending and active request and return their ids —
-        supervisor teardown: a restarting worker must error these out so no
-        client waits forever on a request the new batcher never saw.
+        """Remove every pending, in-flight, and active request and return
+        their ids — supervisor teardown: a restarting worker must error
+        these out so no client waits forever on a request the new batcher
+        never saw.
 
         Runs on the worker thread (the supervisor tears down from inside the
         crashed worker's loop), so touching ``self.active`` here doesn't race
@@ -163,6 +352,11 @@ class ContinuousBatcher:
         with self._lock:
             ids = [req_id for (req_id, *_rest) in self.pending]
             self.pending.clear()
+        if self._inflight is not None:
+            adm, self._inflight = self._inflight, None
+            ids += [req_id for (req_id, *_rest) in adm.taken]
+            with self._lock:
+                self._free.extend(adm.rows)
         for row in list(self.active):
             r = self.active.pop(row)
             ids.append(r.req_id)
@@ -178,37 +372,79 @@ class ContinuousBatcher:
         return self.engine._sample_args(gens, self.rows)
 
     def step(self) -> int:
-        """Admit waiting requests, then advance all active rows one token."""
-        self._process_cancellations()
-        while self._admit_one():
-            pass
-        if not self.active:
-            return 0
+        """One scheduler iteration: resolve last step's admissions, advance
+        all active rows ``chunk_steps`` tokens in one fused scan, and
+        dispatch new admissions to overlap with that scan.
 
+        Rows keep their exact solo tokens (row isolation is positional, and
+        a row that finishes mid-chunk is freed with only its real tokens) —
+        the chunk only batches the host round-trips. Free/finished rows ride
+        along as done rows emitting discarded fills, the same cost a
+        single-step loop pays for inactive rows in the batch.
+        """
+        self._process_cancellations()
+        self._resolve_admission()
+        if not self.active:
+            # Nothing to overlap with: dispatch + resolve immediately.
+            self._inflight = self._admit_dispatch()
+            if self._inflight is not None:
+                self._resolve_admission()
+            if not self.active:
+                return 0
+
+        k = self.chunk_steps
         cur_pos = np.zeros(self.rows, np.int32)
+        done = np.ones(self.rows, bool)
+        eos_arr = np.full(self.rows, -1, np.int32)
         for i, r in self.active.items():
             cur_pos[i] = r.cur_pos
-        with self.engine.metrics.decode_step.time():
+            done[i] = False
+            if r.gen.eos_token_id is not None:
+                eos_arr[i] = r.gen.eos_token_id
+
+        t0 = time.perf_counter()
+        if k > 1:
+            toks, self.cache, _, _ = self.engine._decode_many(
+                self.engine.params, jnp.asarray(self._tokens), self.cache,
+                jnp.asarray(cur_pos), self._sample_args_all(),
+                jnp.asarray(done), jnp.asarray(eos_arr), n_steps=k,
+            )
+        else:
             tok, _, self.cache = self.engine._decode(
                 self.engine.params, jnp.asarray(self._tokens), self.cache,
                 jnp.asarray(cur_pos), self._sample_args_all(),
             )
-            tok_np = np.asarray(tok)
+            toks = tok[:, None]
+        # Admission prefill+insert dispatched while the chunk runs; device
+        # order guarantees the insert lands between this chunk and the
+        # next. Resolved (rows activated) at the top of the next step.
+        self._inflight = self._admit_dispatch()
+        toks_np = np.asarray(toks)  # [rows, k] — the one blocking sync
+        self.engine.metrics.decode_step.record(
+            (time.perf_counter() - t0) / k
+        )
 
         n = 0
         for i in list(self.active):
             r = self.active[i]
-            t = int(tok_np[i])
-            r.cur_pos += 1
             eos = r.gen.eos_token_id if r.gen.eos_token_id is not None else -1
-            if t == eos:
+            finished = False
+            for col in range(k):
+                t = int(toks_np[i, col])
+                r.cur_pos += 1
+                if t == eos:
+                    finished = True
+                    break
+                r.out.append(t)
+                n += 1
+                if len(r.out) >= r.gen.max_new_tokens:
+                    finished = True
+                    break
+            if finished:
                 self._finish(i, r)
-                continue
-            r.out.append(t)
-            n += 1
-            self._tokens[i] = t
-            if len(r.out) >= r.gen.max_new_tokens:
-                self._finish(i, r)
+            else:
+                # Survived the whole chunk: device advanced it k steps.
+                self._tokens[i] = int(toks_np[i, k - 1])
         self._step_count += 1
         self.engine.metrics.add_tokens(n)
         return n
@@ -216,7 +452,10 @@ class ContinuousBatcher:
     @property
     def idle(self) -> bool:
         with self._lock:
-            return not self.active and not self.pending
+            return (
+                not self.active and not self.pending
+                and self._inflight is None
+            )
 
     def run_until_idle(self) -> None:
         while not self.idle:

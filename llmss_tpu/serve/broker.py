@@ -33,16 +33,25 @@ class Broker(abc.ABC):
         self, request_id: str, timeout: float = 60.0
     ) -> GenerateResponse | None: ...
 
-    # Cancellation channel: the producer posts ids whose clients have gone
-    # away (timeout / explicit cancel); workers drain them and stop spending
-    # decode steps on those requests. The reference has no analogue — its
-    # consumer decodes to max_new_tokens no matter what
+    # Cancellation channel: the producer flags ids whose clients have gone
+    # away (timeout / explicit cancel); workers query the flags for the ids
+    # they hold and stop spending decode steps on them. The reference has
+    # no analogue — its consumer decodes to max_new_tokens no matter what
     # (``consumer_server.py:123-166``), so a slow client wastes chip time.
+    #
+    # Flags are TTL'd *membership* state, not a consumed queue: with
+    # multiple workers, a queue drain would let one worker swallow every
+    # id including those owned by others, and a cancel that raced ahead of
+    # its own request would be lost — a flag stays visible until the
+    # request shows up anywhere (or the TTL reaps it).
+    CANCEL_TTL_S = 600.0
+
     def cancel_request(self, request_id: str) -> None:  # noqa: B027
         pass
 
-    def pop_cancellations(self) -> list[str]:
-        return []
+    def check_cancelled(self, request_ids) -> set[str]:
+        """Subset of ``request_ids`` whose cancellation flag is set."""
+        return set()
 
     # Workers publish their metrics snapshot through the broker so the
     # producer can serve GET /metrics even when producer and consumer are
@@ -76,17 +85,19 @@ class InProcBroker(Broker):
         self._responses: dict[str, GenerateResponse] = {}
         self._cond = threading.Condition()
         self._metrics: dict = {}
-        self._cancels: list[str] = []
+        self._cancels: dict[str, float] = {}  # id -> flag deadline
         self._cancel_lock = threading.Lock()
 
     def cancel_request(self, request_id: str) -> None:
         with self._cancel_lock:
-            self._cancels.append(request_id)
+            self._cancels[request_id] = time.monotonic() + self.CANCEL_TTL_S
 
-    def pop_cancellations(self) -> list[str]:
+    def check_cancelled(self, request_ids) -> set[str]:
+        now = time.monotonic()
         with self._cancel_lock:
-            out, self._cancels = self._cancels, []
-        return out
+            for rid in [r for r, t in self._cancels.items() if t <= now]:
+                del self._cancels[rid]
+            return {r for r in request_ids if r in self._cancels}
 
     def publish_metrics(self, metrics: dict) -> None:
         self._metrics = self._merged(metrics)
@@ -134,24 +145,28 @@ class RedisBroker(Broker):
 
     def __init__(self, host: str = "localhost", port: int = 6379,
                  request_queue: str = "pqueue", response_prefix: str = "squeue",
-                 cancel_queue: str = "cancelq"):
+                 cancel_prefix: str = "cancelled"):
         import redis  # gated: optional dependency
 
         self._r = redis.Redis(host=host, port=port)
         self._rq = request_queue
         self._prefix = response_prefix
-        self._cq = cancel_queue
+        self._cancel_prefix = cancel_prefix
 
     def cancel_request(self, request_id: str) -> None:
-        self._r.lpush(self._cq, request_id)
+        # Keyed TTL flag, not a queue entry: every worker can see it, and
+        # it survives a cancel racing ahead of its own request.
+        self._r.set(
+            f"{self._cancel_prefix}:{request_id}", 1,
+            ex=int(self.CANCEL_TTL_S),
+        )
 
-    def pop_cancellations(self) -> list[str]:
-        out = []
-        while True:
-            item = self._r.rpop(self._cq)
-            if item is None:
-                return out
-            out.append(item.decode() if isinstance(item, bytes) else item)
+    def check_cancelled(self, request_ids) -> set[str]:
+        ids = list(request_ids)
+        if not ids:
+            return set()
+        vals = self._r.mget([f"{self._cancel_prefix}:{r}" for r in ids])
+        return {r for r, v in zip(ids, vals) if v is not None}
 
     def push_request(self, req: GenerateRequest) -> None:
         self._r.lpush(self._rq, req.to_json())

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 
 from llmss_tpu.engine import DecodeEngine, GenerationParams
 from llmss_tpu.serve.broker import Broker
@@ -57,19 +58,31 @@ class Worker:
         batch_size: int = 8,
         poll_timeout_s: float = 0.2,
         pad_batch: bool = True,
+        chunk_steps: int = 8,
     ):
         self.engine = engine
         self.broker = broker
         self.tokenizer = tokenizer
         self.batch_size = batch_size
         self.poll_timeout_s = poll_timeout_s
-        self._cancelled: set[str] = set()
+        # Decode steps per host round-trip (engine.generate chunking):
+        # amortizes dispatch + token-fetch latency; cancellation latency
+        # becomes one chunk instead of one step.
+        self.chunk_steps = chunk_steps
         # Pad every live batch up to ``batch_size`` with inert rows so the
         # engine sees one batch shape: without this, each distinct queue
         # drain length compiles a fresh prefill+decode executable — repeated
         # multi-second stalls under bursty load. Batch rows run in parallel
         # on the chip, so the dummy rows are ~free.
         self.pad_batch = pad_batch
+
+    def prewarm(self) -> int:
+        """Compile the worker's full executable envelope up front (every
+        prompt bucket at the padded batch size + decode step/chunks) so the
+        first request of any shape never stalls on a multi-second compile."""
+        return self.engine.prewarm(
+            self.batch_size, chunk_steps=self.chunk_steps
+        )
 
     # -- request plumbing ---------------------------------------------------
 
@@ -96,19 +109,18 @@ class Worker:
 
     # -- serving loop -------------------------------------------------------
 
-    def _drain_cancellations(self) -> None:
-        self._cancelled.update(self.broker.pop_cancellations())
-
     def run_once(self) -> int:
-        self._drain_cancellations()
         batch = self._gather()
         if not batch:
             return 0
 
+        # Cancellation is a broker-side TTL flag (not a consumed queue):
+        # check exactly the ids this worker holds — multi-worker safe, and
+        # a cancel that raced ahead of its request still lands here.
+        cancelled = self.broker.check_cancelled([r.id for r in batch])
         prompts, gens, ok = [], [], []
         for req in batch:
-            if req.id in self._cancelled:
-                self._cancelled.discard(req.id)
+            if req.id in cancelled:
                 self.engine.metrics.add_cancelled()
                 self.broker.push_response(
                     GenerateResponse(id=req.id, error="cancelled")
@@ -134,22 +146,23 @@ class Worker:
                 GenerationParams(max_new_tokens=1, is_greedy=True)
             ] * pad
 
+        mid_cancelled: set[str] = set()
+
         def cancel_poll():
             # Mid-batch cancellation: stop spending decode steps on rows
             # whose clients are gone.
-            self._drain_cancellations()
-            hit = [
-                i for i, req in enumerate(ok) if req.id in self._cancelled
-            ]
-            if hit:
-                self.engine.metrics.add_cancelled(len(hit))
-                for i in hit:
-                    self._cancelled.discard(ok[i].id)
-            return hit
+            hits = self.broker.check_cancelled(
+                [r.id for r in ok if r.id not in mid_cancelled]
+            )
+            if hits:
+                self.engine.metrics.add_cancelled(len(hits))
+                mid_cancelled.update(hits)
+            return [i for i, r in enumerate(ok) if r.id in hits]
 
         try:
             outs = self.engine.generate(
-                prompts, gens, cancel_poll=cancel_poll
+                prompts, gens, cancel_poll=cancel_poll,
+                chunk_steps=self.chunk_steps, live_rows=n_live,
             )[:n_live]
         except Exception as e:  # noqa: BLE001 — batch failure containment
             logger.exception("batch failed")
@@ -164,6 +177,15 @@ class Worker:
             return len(batch)
 
         for req, toks in zip(ok, outs):
+            if req.id in mid_cancelled:
+                # The client is by definition gone — an honest "cancelled"
+                # error (with the partial tokens), not a fake success.
+                self.broker.push_response(
+                    GenerateResponse(
+                        id=req.id, error="cancelled", token_ids=toks,
+                    )
+                )
+                continue
             text = (
                 self.tokenizer.decode(toks) if self.tokenizer is not None
                 else None
@@ -193,15 +215,23 @@ class ContinuousWorker:
         tokenizer=None,
         rows: int = 8,
         poll_timeout_s: float = 0.02,
+        chunk_steps: int = 8,
     ):
         from llmss_tpu.engine.scheduler import ContinuousBatcher
 
         self.engine = engine
         self.broker = broker
         self.tokenizer = tokenizer
-        self.batcher = ContinuousBatcher(engine, rows=rows)
+        self.batcher = ContinuousBatcher(
+            engine, rows=rows, chunk_steps=chunk_steps
+        )
         self.poll_timeout_s = poll_timeout_s
         self._publish_counter = 0
+
+    def prewarm(self, seq_buckets: list[int] | None = None) -> int:
+        """Compile the batcher's full executable envelope up front
+        (``seq_buckets`` narrows the prompt-length envelope when known)."""
+        return self.batcher.prewarm(seq_buckets)
 
     def _drain_broker(self) -> int:
         n = 0
@@ -222,7 +252,16 @@ class ContinuousWorker:
                 )
                 continue
 
-            def cb(toks, req=req):
+            def cb(toks, cancelled=False, req=req):
+                if cancelled:
+                    # Honest response: the client timed out / went away;
+                    # partial tokens ride along, but this is not a success.
+                    self.broker.push_response(
+                        GenerateResponse(
+                            id=req.id, error="cancelled", token_ids=toks,
+                        )
+                    )
+                    return
                 text = (
                     self.tokenizer.decode(toks)
                     if self.tokenizer is not None else None
@@ -238,7 +277,11 @@ class ContinuousWorker:
             n += 1
 
     def run_once(self) -> int:
-        for rid in self.broker.pop_cancellations():
+        # Check the broker's TTL'd cancellation flags for exactly the ids
+        # this batcher holds (pending, in-flight admission, active): the
+        # flag persists until its request shows up, so cancel-before-submit
+        # races land, and other workers' ids are never swallowed.
+        for rid in self.broker.check_cancelled(self.batcher.live_ids()):
             # The batcher frees the row at the top of its next step; the
             # request's done_cb fires with the tokens produced so far.
             self.batcher.cancel(rid)
@@ -277,6 +320,11 @@ def main(argv=None):
              "batch-at-a-time",
     )
     parser.add_argument("--max_seq_len", type=int, default=None)
+    parser.add_argument(
+        "--chunk_steps", type=int, default=8,
+        help="decode steps per host round-trip (1 = per-token streaming "
+             "granularity; higher amortizes host-link latency)",
+    )
     parser.add_argument("--tp", type=int, default=None)
     parser.add_argument("--dp", type=int, default=1)
     parser.add_argument(
@@ -316,10 +364,23 @@ def main(argv=None):
 
     def make_worker():
         if args.continuous:
-            return ContinuousWorker(
-                engine, broker, tokenizer, rows=args.batch_size
+            w = ContinuousWorker(
+                engine, broker, tokenizer, rows=args.batch_size,
+                chunk_steps=args.chunk_steps,
             )
-        return Worker(engine, broker, tokenizer, batch_size=args.batch_size)
+        else:
+            w = Worker(
+                engine, broker, tokenizer, batch_size=args.batch_size,
+                chunk_steps=args.chunk_steps,
+            )
+        # Inside the factory so supervised restarts (fresh batcher, fresh
+        # jit wrappers) also come up fully compiled.
+        t0 = time.time()
+        n = w.prewarm()
+        logger.info(
+            "prewarmed %d executables in %.0fs", n, time.time() - t0
+        )
+        return w
 
     print(
         "consumer serving"

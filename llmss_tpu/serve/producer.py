@@ -20,6 +20,9 @@ from llmss_tpu.serve.protocol import GenerateRequest
 
 
 class ProducerServer:
+    # A worker is unhealthy after this many missed heartbeat intervals.
+    HEARTBEAT_STALE_FACTOR = 3.0
+
     def __init__(self, broker: Broker, host: str = "0.0.0.0",
                  port: int = 8000, timeout_s: float = 300.0):
         self.broker = broker
@@ -40,7 +43,8 @@ class ProducerServer:
 
             def do_GET(self):
                 if self.path == "/health":
-                    self._reply(200, {"status": "ok"})
+                    code, body = outer.health()
+                    self._reply(code, body)
                 elif self.path == "/metrics":
                     self._reply(200, outer.broker.read_metrics())
                 else:
@@ -82,6 +86,34 @@ class ProducerServer:
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: threading.Thread | None = None
+
+    def health(self) -> tuple[int, dict]:
+        """Worker-health-aware /health: a supervised worker publishes
+        ``heartbeat_ts`` through the broker metrics channel
+        (serve/supervisor.py); when it goes stale the endpoint flips to
+        503 instead of serving a green light over a hung worker (which
+        would otherwise pile requests into 504s). Without a supervisor
+        block the endpoint stays a liveness-of-the-producer check."""
+        import time as _time
+
+        sup = self.broker.read_metrics().get("supervisor")
+        if not isinstance(sup, dict) or "heartbeat_ts" not in sup:
+            return 200, {"status": "ok", "worker": "unsupervised"}
+        age = _time.time() - float(sup["heartbeat_ts"])
+        stale_after = (
+            float(sup.get("heartbeat_s", 5.0)) * self.HEARTBEAT_STALE_FACTOR
+        )
+        body = {
+            "heartbeat_age_s": round(age, 3),
+            "stale_after_s": stale_after,
+            "restarts": sup.get("restarts"),
+            "last_error": sup.get("last_error"),
+        }
+        if not sup.get("alive", True):
+            return 503, {"status": "unhealthy", **body}
+        if age > stale_after:
+            return 503, {"status": "stale-heartbeat", **body}
+        return 200, {"status": "ok", **body}
 
     @property
     def port(self) -> int:

@@ -61,6 +61,9 @@ class Supervisor:
             "last_error": self._last_error,
             "uptime_s": round(time.time() - self._start, 1),
             "heartbeat_ts": round(time.time(), 3),
+            # Published so health consumers (producer /health) can judge
+            # staleness without configuration coupling.
+            "heartbeat_s": self.heartbeat_s,
         }
 
     def _publish(self, worker) -> None:

@@ -77,34 +77,43 @@ def test_sampled_seed_matches_isolated(engine):
 
 def test_cancel_frees_row_within_one_step(engine):
     """A cancelled active request stops consuming decode steps within one
-    step: its row frees, its callback fires with the partial tokens, and
-    the rest of the batch is unaffected."""
+    step: its row frees, its callback fires with the partial tokens AND the
+    cancelled flag (so the serving layer answers honestly), and the rest of
+    the batch is unaffected."""
     results = {}
+
+    def cb(key):
+        return lambda t, cancelled=False: results.__setitem__(
+            key, (t, cancelled)
+        )
+
     batcher = ContinuousBatcher(engine, rows=2)
     long_gen = GenerationParams(max_new_tokens=40, is_greedy=True)
-    batcher.submit([1, 2, 3], long_gen, lambda t: results.__setitem__("a", t),
-                   req_id="a")
+    batcher.submit([1, 2, 3], long_gen, cb("a"), req_id="a")
     batcher.submit([4, 5], GenerationParams(max_new_tokens=6, is_greedy=True),
-                   lambda t: results.__setitem__("b", t), req_id="b")
+                   cb("b"), req_id="b")
     for _ in range(3):
         batcher.step()
     assert "a" not in results
     batcher.cancel("a")
     batcher.step()  # processes the cancellation at the top of the step
-    assert "a" in results and 0 < len(results["a"]) < 40
+    assert "a" in results
+    toks_a, cancelled_a = results["a"]
+    assert cancelled_a and 0 < len(toks_a) < 40
     assert not any(r.req_id == "a" for r in batcher.active.values())
     assert engine.metrics.cancelled >= 1
     # remaining request runs to completion untouched
     batcher.run_until_idle()
-    assert len(results["b"]) == 6
+    toks_b, cancelled_b = results["b"]
+    assert not cancelled_b and len(toks_b) == 6
 
-    # cancelling a *pending* (never admitted) request drops it silently
+    # cancelling a *pending* (never admitted) request answers it as
+    # cancelled with no tokens (every submitted request gets one response)
     batcher2 = ContinuousBatcher(engine, rows=1)
-    batcher2.submit([1], long_gen, lambda t: results.__setitem__("c", t),
-                    req_id="c")
+    batcher2.submit([1], long_gen, cb("c"), req_id="c")
     batcher2.cancel("c")
     batcher2.step()
-    assert batcher2.idle and "c" not in results
+    assert batcher2.idle and results["c"] == ([], True)
 
 
 def test_staggered_admission(engine):
@@ -168,3 +177,41 @@ def test_continuous_worker_roundtrip(engine):
     for r in resps:
         assert r is not None and r.error is None
         assert len(r.token_ids) == 4
+
+
+def test_chunked_step_matches_single_step(engine):
+    """chunk_steps batches host round-trips only: tokens must be identical
+    to the single-step scheduler, including mid-chunk EOS/max_new finishes
+    and mid-stream admission."""
+    from llmss_tpu.engine.scheduler import ContinuousBatcher
+
+    def run(chunk):
+        b = ContinuousBatcher(engine, rows=4, chunk_steps=chunk)
+        got = {}
+        b.submit([5, 9, 23], GenerationParams(max_new_tokens=7,
+                                              is_greedy=True),
+                 lambda t: got.__setitem__("a", t), req_id="a")
+        b.submit([3, 14], GenerationParams(max_new_tokens=3, is_greedy=True),
+                 lambda t: got.__setitem__("b", t), req_id="b")
+        b.step()
+        # admit mid-stream while the first two are decoding
+        b.submit([40, 41, 42, 43], GenerationParams(max_new_tokens=5,
+                                                    is_greedy=True),
+                 lambda t: got.__setitem__("c", t), req_id="c")
+        b.run_until_idle()
+        return got
+
+    assert run(1) == run(4)
+
+
+def test_generate_chunked_matches_single(engine):
+    prompts = [[5, 9, 23, 40], [3, 14, 15]]
+    gens = [
+        GenerationParams(max_new_tokens=9, is_greedy=True),
+        GenerationParams(max_new_tokens=4, is_greedy=False,
+                         temperature=0.8, top_k=7, seed=11),
+    ]
+    a = engine.generate(prompts, gens, chunk_steps=1)
+    b = engine.generate(prompts, gens, chunk_steps=4)
+    c = engine.generate(prompts, gens, chunk_steps=64)
+    assert a == b == c
