@@ -32,7 +32,7 @@ import uuid
 import jax
 import numpy as np
 
-from bench import BATCH, DECODE, HBM_GBPS, PROMPT, flagship_cfg
+from bench import DECODE, PROMPT, flagship_cfg, roofline_tokens_per_sec
 
 RATE = float(os.environ.get("SERVE_RATE", 24.0))  # requests/sec
 SECONDS = float(os.environ.get("SERVE_SECONDS", 30.0))
@@ -145,12 +145,7 @@ def main():
     toks = m["tokens_generated"]
     serve_tps = toks / t_wall / n_dev
 
-    kv_bytes_per_token = (
-        2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * 2 * max_seq / 2
-    )
-    roofline = ROWS * HBM_GBPS * 1e9 / (
-        param_bytes + ROWS * kv_bytes_per_token
-    )
+    roofline = roofline_tokens_per_sec(cfg, param_bytes, ROWS, max_seq)
 
     result = {
         "metric": "serve_tokens_per_sec_per_chip",

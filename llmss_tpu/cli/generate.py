@@ -49,6 +49,11 @@ def get_args(argv=None):
         help="sequence-parallel axis (ring-attention long-context prefill)",
     )
     parser.add_argument("--dtype", type=str, default=None)
+    parser.add_argument(
+        "--kv_dtype", type=str, default=None, choices=[None, "int8"],
+        help="int8 = quantized KV cache (half the HBM footprint; "
+             "per-token-per-head scales)",
+    )
     parser.add_argument("--max_seq_len", type=int, default=None)
     parser.add_argument("--seed", type=int, default=0)
     return parser.parse_args(argv)
@@ -94,6 +99,7 @@ def main(argv=None):
 
     engine = DecodeEngine(
         cfg, params, mesh,
+        kv_dtype=args.kv_dtype,
         max_seq_len=args.max_seq_len
         or min(cfg.max_position_embeddings,
                max(len(p) for p in prompts) + args.max_new_tokens),

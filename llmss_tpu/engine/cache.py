@@ -31,14 +31,43 @@ class KVCache(NamedTuple):
     k: jax.Array  # [L, B, T, Hkv, D]
     v: jax.Array  # [L, B, T, Hkv, D]
     positions: jax.Array  # [B, T] int32, -1 = empty slot
+    # Per-(layer, row, slot, head) dequant scales, set iff k/v are int8
+    # (kv_dtype="int8"): value = int8 * scale. Halves cache HBM footprint;
+    # the dequant multiply fuses into the layer-slice copy the decode scan
+    # already materializes, so step traffic *drops* (int8 read replaces a
+    # bf16 read on the copy's input side).
+    k_scale: jax.Array | None = None  # [L, B, T, Hkv] f32
+    v_scale: jax.Array | None = None
 
     @property
     def max_len(self) -> int:
         return self.k.shape[2]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+
+def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-(…, head) int8 quantization over the feature dim.
+
+    Returns (int8 values, f32 scales of x.shape[:-1]). Scale floor keeps
+    all-zero rows (empty slots) exact and division finite."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    return q.astype(dtype) * scale[..., None].astype(dtype)
+
 
 def cache_specs(
-    n_kv_heads: int, tp: int, *, batch_dp: bool = True, seq_sp: bool = False
+    n_kv_heads: int, tp: int, *, batch_dp: bool = True, seq_sp: bool = False,
+    quantized: bool = False,
 ) -> KVCache:
     """PartitionSpecs for the cache pytree.
 
@@ -52,7 +81,11 @@ def cache_specs(
     dp_axis = AXIS_DP if batch_dp else None
     seq_axis = AXIS_SP if seq_sp else None
     kv = P(None, dp_axis, seq_axis, head_axis, None)
-    return KVCache(k=kv, v=kv, positions=P(dp_axis, seq_axis))
+    scale = P(None, dp_axis, seq_axis, head_axis) if quantized else None
+    return KVCache(
+        k=kv, v=kv, positions=P(dp_axis, seq_axis),
+        k_scale=scale, v_scale=scale,
+    )
 
 
 def init_cache(
@@ -65,11 +98,13 @@ def init_cache(
     head_dim: int,
     dtype=jnp.bfloat16,
 ) -> KVCache:
+    quantized = jnp.dtype(dtype) == jnp.int8
     specs = cache_specs(
         n_kv_heads,
         mesh.shape[AXIS_TP],
         batch_dp=batch % mesh.shape[AXIS_DP] == 0,
         seq_sp=mesh.shape[AXIS_SP] > 1 and max_len % mesh.shape[AXIS_SP] == 0,
+        quantized=quantized,
     )
     shape = (n_layers, batch, max_len, n_kv_heads, head_dim)
 
@@ -82,6 +117,14 @@ def init_cache(
         k=zeros(specs.k, shape, dtype),
         v=zeros(specs.v, shape, dtype),
         positions=zeros(specs.positions, (batch, max_len), jnp.int32) - 1,
+        k_scale=(
+            zeros(specs.k_scale, shape[:-1], jnp.float32)
+            if quantized else None
+        ),
+        v_scale=(
+            zeros(specs.v_scale, shape[:-1], jnp.float32)
+            if quantized else None
+        ),
     )
 
 

@@ -83,6 +83,7 @@ class DecodeEngine:
         *,
         batch_size: int = 1,
         max_seq_len: int | None = None,
+        kv_dtype: str | None = None,
     ):
         from llmss_tpu.utils.metrics import EngineMetrics
 
@@ -91,7 +92,22 @@ class DecodeEngine:
         self.mesh = mesh
         self.batch_size = batch_size
         self.max_seq_len = max_seq_len or cfg.max_position_embeddings
-        self._cache_dtype = cfg.compute_dtype
+        # kv_dtype="int8" stores the cache quantized (per-token-per-head
+        # scales): half the HBM footprint → double the rows/context per
+        # chip, and the dequant rides the decode scan's existing layer
+        # copy. Values-only quality cost (see tests/test_int8_cache.py).
+        if kv_dtype == "int8":
+            from llmss_tpu.parallel.mesh import AXIS_SP
+
+            if mesh is not None and mesh.shape[AXIS_SP] > 1:
+                raise ValueError(
+                    "kv_dtype='int8' does not support sp>1 meshes yet "
+                    "(the sequence-parallel attention paths read the "
+                    "cache raw)"
+                )
+            self._cache_dtype = jnp.int8
+        else:
+            self._cache_dtype = cfg.compute_dtype
         self.metrics = EngineMetrics()
 
         # mesh is partial-bound (a compile-time constant, not a traced arg):

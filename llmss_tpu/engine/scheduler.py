@@ -52,10 +52,9 @@ class _InFlightAdmission:
     first tokens have not been fetched: resolved (rows activated) at the
     top of the next step, overlapping admission with the decode chunk."""
 
-    taken: list  # [(req_id, ids, gen, cb)]
+    taken: list  # [(req_id, ids, gen, cb, t_submit)]
     rows: list[int]
     tok: jax.Array  # [P] first sampled token per admission row (device)
-    t0: float  # dispatch wall-clock, for TTFT accounting
 
 
 class ContinuousBatcher:
@@ -99,6 +98,14 @@ class ContinuousBatcher:
             v=big.v.at[:, rows].set(small.v, mode="drop"),
             positions=big.positions.at[rows].set(
                 small.positions, mode="drop"
+            ),
+            k_scale=(
+                big.k_scale.at[:, rows].set(small.k_scale, mode="drop")
+                if big.k_scale is not None else None
+            ),
+            v_scale=(
+                big.v_scale.at[:, rows].set(small.v_scale, mode="drop")
+                if big.v_scale is not None else None
             ),
         )
 
@@ -162,8 +169,7 @@ class ContinuousBatcher:
         # survive into real serving. device_put with the original sharding:
         # an eager op could re-commit the array and key fresh compiles for
         # every executable that takes the cache.
-        self.cache = KVCache(
-            k=self.cache.k, v=self.cache.v,
+        self.cache = self.cache._replace(
             positions=jax.device_put(
                 jnp.full_like(self.cache.positions, -1),
                 self.cache.positions.sharding,
@@ -182,7 +188,9 @@ class ContinuousBatcher:
     ) -> None:
         gen.validate()
         with self._lock:
-            self.pending.append((req_id, list(token_ids), gen, done_cb))
+            self.pending.append(
+                (req_id, list(token_ids), gen, done_cb, time.perf_counter())
+            )
 
     # -- scheduling ---------------------------------------------------------
 
@@ -215,13 +223,13 @@ class ContinuousBatcher:
         while P < n:
             P *= 2
         S = _bucket(
-            max(len(ids) for _rid, ids, _g, _cb in taken),
+            max(len(ids) for _rid, ids, _g, _cb, _t in taken),
             self.engine.max_seq_len,
         )
         padded = np.zeros((P, S), np.int32)
         lens = np.ones(P, np.int32)  # dummy rows prefill one pad token
         gens = []
-        for i, (_rid, ids, gen, _cb) in enumerate(taken):
+        for i, (_rid, ids, gen, _cb, _t) in enumerate(taken):
             padded[i, : len(ids)] = ids
             lens[i] = len(ids)
             gens.append(gen)
@@ -229,7 +237,6 @@ class ContinuousBatcher:
         row_idx = np.full(P, -1, np.int32)  # -1 = dropped by the scatter
         row_idx[:n] = rows
 
-        t0 = time.perf_counter()
         scratch = self.engine.new_cache(P)
         sample_args = self.engine._sample_args(gens, P)
         tok, _, scratch = self._prefill_row(
@@ -239,7 +246,7 @@ class ContinuousBatcher:
         self.cache = self._insert(
             self.cache, scratch, jnp.asarray(row_idx)
         )
-        return _InFlightAdmission(taken=taken, rows=rows, tok=tok, t0=t0)
+        return _InFlightAdmission(taken=taken, rows=rows, tok=tok)
 
     def _resolve_admission(self) -> int:
         """Activate the previously dispatched admission batch (fetch its
@@ -248,27 +255,27 @@ class ContinuousBatcher:
         if adm is None:
             return 0
         firsts = np.asarray(adm.tok)
-        # dt spans dispatch → resolve, i.e. includes the decode chunk the
-        # admission deliberately overlapped — the honest time-to-first-
-        # token. It is NOT recorded as prefill latency (the prefill stat
-        # stays a tight measure of prefill compute on the non-overlapped
-        # paths; recording dt there would inflate it by a chunk).
-        dt = time.perf_counter() - adm.t0
-        for _ in adm.taken:
-            self.engine.metrics.ttft.record(dt)
-        self.engine.metrics.add_request(len(adm.taken))
-
+        now = time.perf_counter()
         cancelled = self._cancel_at_resolve
         self._cancel_at_resolve = set()
-        for i, (req_id, ids, gen, cb) in enumerate(adm.taken):
+        for i, (req_id, ids, gen, cb, t_submit) in enumerate(adm.taken):
             row = adm.rows[i]
             r = _Row(
                 req_id=req_id, gen=gen, out=[], cur_pos=len(ids), done_cb=cb
             )
             if req_id in cancelled:
+                # Not served, no TTFT sample — matches the static Worker's
+                # accounting for pre-cancelled requests.
                 self.engine.metrics.add_cancelled(1)
                 self._finish(row, r, cancelled=True)
                 continue
+            # TTFT spans submit → resolve: queueing for a free row, the
+            # admission prefill, AND the decode chunk the admission
+            # deliberately overlapped — the time a client actually waited
+            # for its first token. NOT recorded as prefill latency (that
+            # stat stays a tight measure of prefill compute).
+            self.engine.metrics.ttft.record(now - t_submit)
+            self.engine.metrics.add_request(1)
             first = int(firsts[i])
             eos = gen.eos_token_id if gen.eos_token_id is not None else -1
             if first == eos or gen.max_new_tokens == 0:
@@ -314,7 +321,7 @@ class ContinuousBatcher:
             dropped = [p for p in self.pending if p[0] in ids]
             self.pending = deque(p for p in self.pending if p[0] not in ids)
         n = len(dropped)
-        for _rid, _ids, _gen, cb in dropped:
+        for _rid, _ids, _gen, cb, _t in dropped:
             cb([], True)
         if self._inflight is not None:
             for req_id, *_rest in self._inflight.taken:
@@ -418,10 +425,15 @@ class ContinuousBatcher:
         # Admission prefill+insert dispatched while the chunk runs; device
         # order guarantees the insert lands between this chunk and the
         # next. Resolved (rows activated) at the top of the next step.
+        t_adm = time.perf_counter()
         self._inflight = self._admit_dispatch()
+        t_adm = time.perf_counter() - t_adm
         toks_np = np.asarray(toks)  # [rows, k] — the one blocking sync
+        # Admission prep (host-side padding + dispatches) overlaps the
+        # chunk on device but not on the host clock — subtract it so the
+        # decode_step stat stays a clean per-token latency.
         self.engine.metrics.decode_step.record(
-            (time.perf_counter() - t0) / k
+            (time.perf_counter() - t0 - t_adm) / k
         )
 
         n = 0

@@ -30,7 +30,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from llmss_tpu.engine.cache import KVCache, write_layer, write_positions
+from llmss_tpu.engine.cache import (
+    KVCache, dequantize_kv, quantize_kv, write_layer, write_positions,
+)
 from llmss_tpu.models.common import DecoderConfig, act_fn
 from llmss_tpu.ops.attention import (
     dispatch_attention,
@@ -490,9 +492,11 @@ def forward(
         mesh is None or mesh.shape[AXIS_SP] == 1 or sp_attn is not None
     )
 
+    quant = cache.quantized
     if defer_write:
-        kernel_attn = _make_decode_kernel_attn(cfg, mesh, cache, positions,
-                                               slots)
+        kernel_attn = None if quant else _make_decode_kernel_attn(
+            cfg, mesh, cache, positions, slots
+        )
         if kernel_attn is not None and _ablate is None:
             # Stacked-cache Pallas path: the scan carries only params + the
             # layer index; the kernel's block DMAs read the layer's KV
@@ -514,7 +518,15 @@ def forward(
             )
         else:
             def body(h, xs):
-                bp, k_l, v_l = xs
+                if quant:
+                    bp, k_q, v_q, ks_l, vs_l = xs
+                    # Dequant fuses into the layer-slice copy the scan
+                    # materializes anyway (engine/cache.py: int8 read in,
+                    # compute-dtype out).
+                    k_l = dequantize_kv(k_q, ks_l, dtype)
+                    v_l = dequantize_kv(v_q, vs_l, dtype)
+                else:
+                    bp, k_l, v_l = xs
                 h, k_f, v_f = _block(
                     cfg, bp, h, positions, k_l, v_l, cache.positions, slots,
                     None, mesh=mesh, defer_write=True,
@@ -523,15 +535,24 @@ def forward(
                 ys = None if _ablate == "no_scatter" else (k_f, v_f)
                 return h, ys
 
-            h, ys = jax.lax.scan(
-                body, h, (params["blocks"], cache.k, cache.v)
+            xs = (
+                (params["blocks"], cache.k, cache.v, cache.k_scale,
+                 cache.v_scale)
+                if quant else (params["blocks"], cache.k, cache.v)
             )
+            h, ys = jax.lax.scan(body, h, xs)
+        ks_new, vs_new = cache.k_scale, cache.v_scale
         if _ablate == "no_scatter":
             k_new, v_new = cache.k, cache.v
         else:
             k_fresh, v_fresh = ys
             B = input_ids.shape[0]
             b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+            if quant:
+                k_fresh, ks_f = quantize_kv(k_fresh)
+                v_fresh, vs_f = quantize_kv(v_fresh)
+                ks_new = cache.k_scale.at[:, b_idx, slots].set(ks_f)
+                vs_new = cache.v_scale.at[:, b_idx, slots].set(vs_f)
             k_new = cache.k.at[:, b_idx, slots].set(
                 k_fresh.astype(cache.k.dtype)
             )
@@ -543,16 +564,36 @@ def forward(
         mask = make_causal_mask(positions, new_kv_positions, kv_valid)
 
         def body(h, xs):
-            bp, k_l, v_l = xs
+            if quant:
+                bp, k_q, v_q, ks_l, vs_l = xs
+                k_l = dequantize_kv(k_q, ks_l, dtype)
+                v_l = dequantize_kv(v_q, vs_l, dtype)
+            else:
+                bp, k_l, v_l = xs
             h, k_l, v_l = _block(
                 cfg, bp, h, positions, k_l, v_l, new_kv_positions, slots,
                 mask, mesh=mesh,
             )
+            if quant:
+                # Re-quantize the written layer. Exact for untouched slots:
+                # quantize_kv always maps the per-head max to ±127, so a
+                # dequant→quant round trip reproduces the stored int8.
+                k_q, ks_l = quantize_kv(k_l)
+                v_q, vs_l = quantize_kv(v_l)
+                return h, (k_q, v_q, ks_l, vs_l)
             return h, (k_l, v_l)
 
-        h, (k_new, v_new) = jax.lax.scan(
-            body, h, (params["blocks"], cache.k, cache.v)
-        )
+        if quant:
+            h, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+                body, h,
+                (params["blocks"], cache.k, cache.v, cache.k_scale,
+                 cache.v_scale),
+            )
+        else:
+            ks_new, vs_new = None, None
+            h, (k_new, v_new) = jax.lax.scan(
+                body, h, (params["blocks"], cache.k, cache.v)
+            )
 
     h = _norm(cfg, h, params["ln_f"])
     if gather_idx is not None:
@@ -563,7 +604,10 @@ def forward(
 
     if _ablate == "no_head":
         logits = h[..., :8].astype(jnp.float32)
-        return logits, KVCache(k=k_new, v=v_new, positions=new_kv_positions)
+        return logits, KVCache(
+            k=k_new, v=v_new, positions=new_kv_positions,
+            k_scale=ks_new, v_scale=vs_new,
+        )
     if cfg.tie_word_embeddings:
         # Tied head (gpt_bigcode_modeling.py:792-797): contract against the
         # vocab-sharded embedding; constraining the output replicated makes
@@ -577,4 +621,7 @@ def forward(
         logits = lm_head(h, params["head"])
     logits = constrain(logits, P(AXIS_DP, None, None))
 
-    return logits, KVCache(k=k_new, v=v_new, positions=new_kv_positions)
+    return logits, KVCache(
+        k=k_new, v=v_new, positions=new_kv_positions,
+        k_scale=ks_new, v_scale=vs_new,
+    )

@@ -150,7 +150,11 @@ class Worker:
 
         def cancel_poll():
             # Mid-batch cancellation: stop spending decode steps on rows
-            # whose clients are gone.
+            # whose clients are gone. Publishing here also keeps the
+            # supervisor heartbeat fresh through a long batch (the merge
+            # hook stamps heartbeat_ts at publish time) — without it a
+            # multi-thousand-token batch reads as a hung worker.
+            self.broker.publish_metrics(self.engine.metrics.to_dict())
             hits = self.broker.check_cancelled(
                 [r.id for r in ok if r.id not in mid_cancelled]
             )
@@ -288,7 +292,11 @@ class ContinuousWorker:
         n = self._drain_broker()
         self.batcher.step()
         self._publish_counter += 1
-        if n or self._publish_counter % 64 == 0:
+        # Every 16 iterations even when idle: with chunked steps (~0.3 s
+        # each under load) a sparser cadence would let the supervisor
+        # heartbeat go stale mid-serve (producer /health flips at
+        # 3× heartbeat_s).
+        if n or self._publish_counter % 16 == 0:
             self.broker.publish_metrics(self.engine.metrics.to_dict())
         return n
 
@@ -333,6 +341,10 @@ def main(argv=None):
              "with chips)",
     )
     parser.add_argument("--dtype", type=str, default=None)
+    parser.add_argument(
+        "--kv_dtype", type=str, default=None, choices=[None, "int8"],
+        help="int8 = quantized KV cache (double the rows per chip)",
+    )
     parser.add_argument("--redis_host", default="localhost")
     parser.add_argument("--redis_port", type=int, default=6379)
     parser.add_argument(
@@ -356,7 +368,7 @@ def main(argv=None):
     dtype = args.dtype or str(default_compute_dtype())
     cfg, params = load_model(args.pretrained_model_path, mesh, dtype=dtype)
     engine = DecodeEngine(
-        cfg, params, mesh,
+        cfg, params, mesh, kv_dtype=args.kv_dtype,
         max_seq_len=args.max_seq_len or cfg.max_position_embeddings,
     )
     tokenizer = AutoTokenizer.from_pretrained(args.pretrained_model_path)

@@ -27,6 +27,7 @@ class ProducerServer:
                  port: int = 8000, timeout_s: float = 300.0):
         self.broker = broker
         self.timeout_s = timeout_s
+        self._saw_supervisor = False
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -93,12 +94,22 @@ class ProducerServer:
         (serve/supervisor.py); when it goes stale the endpoint flips to
         503 instead of serving a green light over a hung worker (which
         would otherwise pile requests into 504s). Without a supervisor
-        block the endpoint stays a liveness-of-the-producer check."""
+        block the endpoint stays a liveness-of-the-producer check — but
+        once a supervisor has been seen, its *absence* is itself unhealthy
+        (the Redis metrics key has a TTL: a hung worker's stale block
+        expires after ~120 s, which must not read as recovery)."""
         import time as _time
 
         sup = self.broker.read_metrics().get("supervisor")
         if not isinstance(sup, dict) or "heartbeat_ts" not in sup:
+            if self._saw_supervisor:
+                return 503, {
+                    "status": "no-heartbeat-data",
+                    "detail": "supervisor block seen before but gone "
+                              "(metrics expired — worker presumed hung)",
+                }
             return 200, {"status": "ok", "worker": "unsupervised"}
+        self._saw_supervisor = True
         age = _time.time() - float(sup["heartbeat_ts"])
         stale_after = (
             float(sup.get("heartbeat_s", 5.0)) * self.HEARTBEAT_STALE_FACTOR

@@ -315,8 +315,10 @@ def test_health_flips_on_stale_heartbeat(serving):
     r = httpx.get(f"http://127.0.0.1:{server.port}/health", timeout=10)
     assert r.status_code == 503 and r.json()["status"] == "unhealthy"
 
-    # Restore: unsupervised brokers stay plain-ok.
+    # Supervisor block vanishing after having been seen (metrics TTL
+    # expiry over a hung worker) must NOT read as recovery.
     broker.metrics_extra = None
     broker.publish_metrics({})
     r = httpx.get(f"http://127.0.0.1:{server.port}/health", timeout=10)
-    assert r.status_code == 200
+    assert r.status_code == 503
+    assert r.json()["status"] == "no-heartbeat-data"
