@@ -1,7 +1,8 @@
 """Model zoo: pure-function decoders over parameter pytrees.
 
 TPU-native replacement for the reference's ``custom_modeling/`` (GPT-J,
-GPT-BigCode), extended with GPT-2 and Llama for the BASELINE.md config ladder.
+GPT-BigCode), extended with GPT-2 and Llama for the BASELINE.md config
+ladder, and Mistral (sliding-window attention).
 All models share one unified decoder (``decoder.py``) driven by a
 ``DecoderConfig``; per-model modules translate HF configs and checkpoint
 name layouts.

@@ -1,0 +1,30 @@
+"""Mistral: the Llama block with sliding-window attention.
+
+Checkpoint layout is byte-identical to Llama's
+(``model.layers.N.self_attn.{q,k,v,o}_proj`` etc.), so loading delegates
+wholesale; the model-level difference is ``config.sliding_window``, which
+the attention stack implements end-to-end (XLA mask, Pallas flash
+block-skip, ring/LSE-merge, fresh-KV decode — tests/test_window.py). The
+reference has no windowed-attention model at all; its nearest mechanism is
+the host-side KV trim at ``n_positions`` (``generate.py:132-142``), which
+the ring-buffer cache already generalizes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from llmss_tpu.models import llama
+from llmss_tpu.models.common import DecoderConfig
+
+
+def config_from_hf(hf, dtype: str = "bfloat16") -> DecoderConfig:
+    cfg = llama.config_from_hf(hf, dtype=dtype)
+    return dataclasses.replace(
+        cfg,
+        model_type="mistral",
+        sliding_window=getattr(hf, "sliding_window", None),
+    )
+
+
+load_params = llama.load_params

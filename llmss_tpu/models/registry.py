@@ -12,7 +12,7 @@ from pathlib import Path
 
 from jax.sharding import Mesh
 
-from llmss_tpu.models import gpt2, gpt_bigcode, gptj, llama
+from llmss_tpu.models import gpt2, gpt_bigcode, gptj, llama, mistral
 from llmss_tpu.models.common import DecoderConfig
 from llmss_tpu.models.decoder import Params
 from llmss_tpu.weights import CheckpointShards, weight_files
@@ -22,6 +22,7 @@ MODEL_REGISTRY = {
     "gpt_bigcode": gpt_bigcode,
     "gpt2": gpt2,
     "llama": llama,
+    "mistral": mistral,
 }
 
 

@@ -51,6 +51,17 @@ def _save_hf(tmp_path, model_type):
             tie_word_embeddings=False,
         )
         model = tr.LlamaForCausalLM(cfg)
+    elif model_type == "mistral":
+        # sliding_window < S so the window actually clips attention in the
+        # parity prompt (HF masks it in-forward; here it rides the mask /
+        # kernels — tests/test_window.py covers the impl paths).
+        cfg = tr.MistralConfig(
+            vocab_size=64, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            intermediate_size=48, max_position_embeddings=32,
+            sliding_window=6, tie_word_embeddings=False,
+        )
+        model = tr.MistralForCausalLM(cfg)
     else:
         raise KeyError(model_type)
     model.eval()
@@ -67,7 +78,7 @@ def _hf_logits(model, ids):
 
 
 @pytest.mark.parametrize(
-    "model_type", ["gptj", "gpt_bigcode", "gpt2", "llama"]
+    "model_type", ["gptj", "gpt_bigcode", "gpt2", "llama", "mistral"]
 )
 def test_full_forward_parity(tmp_path, devices, model_type):
     d, hf_model = _save_hf(tmp_path, model_type)
@@ -96,7 +107,7 @@ def test_full_forward_parity(tmp_path, devices, model_type):
     )
 
 
-@pytest.mark.parametrize("model_type", ["gptj", "llama"])
+@pytest.mark.parametrize("model_type", ["gptj", "llama", "mistral"])
 def test_incremental_decode_parity(tmp_path, devices, model_type):
     """Prefill then token-by-token decode must equal the full forward."""
     d, hf_model = _save_hf(tmp_path, model_type)
