@@ -151,7 +151,7 @@ def main():
         "metric": "serve_tokens_per_sec_per_chip",
         "value": round(serve_tps, 1),
         "unit": (
-            f"tok/s/chip (1.2B bf16, continuous batching rows={ROWS}, "
+            f"tok/s/chip (1.2B-class bf16, continuous batching rows={ROWS}, "
             f"poisson {RATE} req/s x {SECONDS:.0f}s, {done}/"
             f"{len(submitted)} served, ttft_p50={m['ttft']['p50_ms']}ms "
             f"p95={m['ttft']['p95_ms']}ms, e2e_p50={pct(50)}s "
