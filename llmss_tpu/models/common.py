@@ -44,6 +44,10 @@ class DecoderConfig:
     norm: str = "layernorm"  # "layernorm" | "rmsnorm"
     norm_eps: float = 1e-5
     parallel_residual: bool = False  # GPT-J block form
+    # GPT-NeoX variant of the parallel block: the MLP branch gets its own
+    # pre-norm (h + attn(ln1(h)) + mlp(ln2(h))) instead of sharing GPT-J's
+    # single norm. Only meaningful with parallel_residual=True.
+    parallel_residual_ln2: bool = False
     mlp: str = "mlp"  # "mlp" | "swiglu"
 
     positions: str = "learned"  # "learned" | "rotary" | "none"
@@ -58,6 +62,8 @@ class DecoderConfig:
     sliding_window: int | None = None
 
     attn_bias: bool = True
+    # Qwen2 puts biases on q/k/v but not o_proj; None = follow attn_bias.
+    attn_out_bias: bool | None = None
     mlp_bias: bool = True
     head_bias: bool = False
     tie_word_embeddings: bool = False
@@ -74,6 +80,20 @@ class DecoderConfig:
         import jax.numpy as jnp
 
         return jnp.dtype(self.dtype)
+
+    @property
+    def has_ln2(self) -> bool:
+        """Whether blocks carry a second norm: sequential blocks always do;
+        parallel-residual blocks only in the NeoX form. The single source
+        of truth for param specs/shapes and the forward pass."""
+        return not self.parallel_residual or self.parallel_residual_ln2
+
+    @property
+    def o_bias(self) -> bool:
+        return (
+            self.attn_bias if self.attn_out_bias is None
+            else self.attn_out_bias
+        )
 
     @property
     def q_size(self) -> int:

@@ -98,10 +98,10 @@ def param_specs(cfg: DecoderConfig, tp: int) -> Params:
             b=P(None, kv_axis) if cfg.attn_bias else None,
         ),
         "o": LinearParams(
-            w=P(None, AXIS_TP, None), b=P(None) if cfg.attn_bias else None
+            w=P(None, AXIS_TP, None), b=P(None) if cfg.o_bias else None
         ),
     }
-    if not cfg.parallel_residual:
+    if cfg.has_ln2:
         blocks["ln2"] = _norm_specs(True, norm_bias)
     if cfg.mlp == "swiglu":
         blocks["gate"] = LinearParams(w=P(None, None, AXIS_TP), b=None)
@@ -178,9 +178,9 @@ def param_shapes(cfg: DecoderConfig) -> Params:
         "q": LinearParams(sds(L, Q, E), sds(L, Q) if cfg.attn_bias else None),
         "k": LinearParams(sds(L, KV, E), sds(L, KV) if cfg.attn_bias else None),
         "v": LinearParams(sds(L, E, KV), sds(L, KV) if cfg.attn_bias else None),
-        "o": LinearParams(sds(L, Q, E), sds(L, E) if cfg.attn_bias else None),
+        "o": LinearParams(sds(L, Q, E), sds(L, E) if cfg.o_bias else None),
     }
-    if not cfg.parallel_residual:
+    if cfg.has_ln2:
         blocks["ln2"] = norm_shape(True)
     if cfg.mlp == "swiglu":
         blocks["gate"] = LinearParams(sds(L, E, I), None)
@@ -298,8 +298,10 @@ def _block(
 
     if cfg.parallel_residual:
         # GPT-J form: one pre-LN feeds both branches; residual adds both
-        # (gptj_modeling.py:295-310).
-        h = res + attn + _mlp(cfg, bp, x)
+        # (gptj_modeling.py:295-310). GPT-NeoX gives the MLP branch its
+        # own pre-norm (parallel_residual_ln2).
+        mlp_in = _norm(cfg, res, bp["ln2"]) if cfg.has_ln2 else x
+        h = res + attn + _mlp(cfg, bp, mlp_in)
     else:
         h = res + attn
         x2 = _norm(cfg, h, bp["ln2"])

@@ -1,0 +1,161 @@
+"""GPT-NeoX / Pythia: fused QKV, partial rotary, NeoX parallel residual.
+
+Block traits vs the families already in the zoo:
+
+- **Parallel residual with separate norms**: ``h + attn(ln1(h)) +
+  mlp(ln2(h))`` (``use_parallel_residual``) — GPT-J's single-norm parallel
+  form with an extra MLP pre-norm (``DecoderConfig.parallel_residual_ln2``).
+- **Partial rotary** via ``rotary_pct`` in *half* (rotate-half) style.
+- **Head-interleaved fused QKV**: ``attention.query_key_value`` packs the
+  weight as ``[heads, 3, head_dim, hidden]`` — per-head Q,K,V interleaved,
+  not contiguous Q|K|V blocks, so the sub-range sliced reads used for
+  GPT-2's ``c_attn`` can't address it. These tensors are read whole and
+  re-indexed host-side before sharding (a full read per tensor — the same
+  concession the reference makes for BigCode's fused c_attn,
+  ``gpt_bigcode_modeling.py:120-155``; NeoX checkpoints are small enough
+  that this is load-time noise).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from llmss_tpu.models._loading import stacked_linear, stacked_norm
+from llmss_tpu.models.common import DecoderConfig
+from llmss_tpu.models.decoder import Params, param_specs
+from llmss_tpu.ops.layers import LinearParams, NormParams, load_lm_head
+from llmss_tpu.parallel.mesh import AXIS_TP
+from llmss_tpu.weights.loader import CheckpointShards
+
+
+def config_from_hf(hf, dtype: str = "bfloat16") -> DecoderConfig:
+    head_dim = hf.hidden_size // hf.num_attention_heads
+    return DecoderConfig(
+        model_type="gpt_neox",
+        vocab_size=hf.vocab_size,
+        hidden_size=hf.hidden_size,
+        n_layers=hf.num_hidden_layers,
+        n_heads=hf.num_attention_heads,
+        n_kv_heads=hf.num_attention_heads,
+        head_dim=head_dim,
+        intermediate_size=hf.intermediate_size,
+        max_position_embeddings=hf.max_position_embeddings,
+        activation=hf.hidden_act,
+        norm="layernorm",
+        norm_eps=hf.layer_norm_eps,
+        parallel_residual=bool(
+            getattr(hf, "use_parallel_residual", True)
+        ),
+        parallel_residual_ln2=bool(
+            getattr(hf, "use_parallel_residual", True)
+        ),
+        mlp="mlp",
+        positions="rotary",
+        rope_style="half",
+        rotary_dim=int(head_dim * getattr(hf, "rotary_pct", 0.25)),
+        rope_theta=float(getattr(hf, "rotary_emb_base", 10000.0)),
+        attn_bias=bool(getattr(hf, "attention_bias", True)),
+        mlp_bias=True,
+        tie_word_embeddings=bool(getattr(hf, "tie_word_embeddings", False)),
+        dtype=dtype,
+    )
+
+
+def _load_fused_qkv(
+    ckpt: CheckpointShards, cfg: DecoderConfig, mesh: Mesh, specs
+) -> dict[str, LinearParams]:
+    """Split NeoX's head-interleaved fused tensors into q/k/v, stacked
+    over layers — one full read per tensor, all three parts emitted.
+
+    Bias presence follows ``cfg.attn_bias`` (so the sharding specs always
+    agree); a checkpoint missing a tensor the config promises fails loudly
+    in ``get_tensor``."""
+    import jax
+
+    L, H, D, E = cfg.n_layers, cfg.n_heads, cfg.head_dim, cfg.hidden_size
+    ws = {k: [] for k in "qkv"}
+    bs = {k: [] for k in "qkv"}
+    for i in range(L):
+        prefix = f"gpt_neox.layers.{i}.attention.query_key_value"
+        w = ckpt.get_tensor(f"{prefix}.weight")  # [3E, E] torch [out, in]
+        w = w.reshape(H, 3, D, E)
+        b = (
+            ckpt.get_tensor(f"{prefix}.bias").reshape(H, 3, D)
+            if cfg.attn_bias else None
+        )
+        for part, key in enumerate("qkv"):
+            ws[key].append(w[:, part].reshape(H * D, E))
+            if b is not None:
+                bs[key].append(b[:, part].reshape(H * D))
+
+    out = {}
+    for key in "qkv":
+        w = np.stack(ws[key])  # [L, out, in]
+        if key == "v":
+            w = w.transpose(0, 2, 1)  # v stores [L, in, out] (param_specs)
+        out[key] = LinearParams(
+            w=jax.device_put(
+                w, NamedSharding(mesh, specs["blocks"][key].w)
+            ),
+            b=(
+                jax.device_put(
+                    np.stack(bs[key]),
+                    NamedSharding(mesh, specs["blocks"][key].b),
+                )
+                if cfg.attn_bias else None
+            ),
+        )
+    return out
+
+
+def load_params(
+    ckpt: CheckpointShards, cfg: DecoderConfig, mesh: Mesh
+) -> Params:
+    specs = param_specs(cfg, mesh.shape[AXIS_TP])
+    L = cfg.n_layers
+    layers = "gpt_neox.layers"
+
+    def lin(attr, key, transpose=True):
+        return stacked_linear(
+            ckpt, lambda i: f"{layers}.{i}.{attr}", L, mesh,
+            specs["blocks"][key].w, specs["blocks"][key].b,
+            transpose=transpose, bias=True,
+        )
+
+    blocks: Params = {
+        "ln1": stacked_norm(
+            ckpt, lambda i: f"{layers}.{i}.input_layernorm", L, mesh,
+        ),
+        "ln2": stacked_norm(
+            ckpt, lambda i: f"{layers}.{i}.post_attention_layernorm", L,
+            mesh,
+        ),
+        **_load_fused_qkv(ckpt, cfg, mesh, specs),
+        # o/mlp are plain torch Linears ([out, in] on disk; the decoder
+        # stores them [L, in, out] for x @ w, so they transpose on load —
+        # same as llama.py's o/gate/up/down).
+        "o": lin("attention.dense", "o"),
+        "fc_in": lin("mlp.dense_h_to_4h", "fc_in"),
+        "fc_out": lin("mlp.dense_4h_to_h", "fc_out"),
+    }
+    params: Params = {
+        "wte": ckpt.get_array(
+            "gpt_neox.embed_in.weight", mesh, specs["wte"]
+        ),
+        "blocks": blocks,
+        "ln_f": NormParams(
+            scale=ckpt.get_array(
+                "gpt_neox.final_layer_norm.weight", mesh,
+                specs["ln_f"].scale,
+            ),
+            bias=ckpt.get_array(
+                "gpt_neox.final_layer_norm.bias", mesh, specs["ln_f"].bias
+            ),
+        ),
+    }
+    if not cfg.tie_word_embeddings:
+        params["head"] = load_lm_head(
+            ckpt, "embed_out.weight", mesh, transpose=True, bias=False
+        )
+    return params

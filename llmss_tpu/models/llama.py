@@ -40,7 +40,11 @@ def config_from_hf(hf, dtype: str = "bfloat16") -> DecoderConfig:
         rope_style="half",
         rotary_dim=head_dim,
         rope_theta=getattr(hf, "rope_theta", 10000.0),
-        attn_bias=False,
+        # Llama-architecture conversions may carry attention biases
+        # (LlamaConfig.attention_bias, e.g. InternLM/Yi-style exports);
+        # the spec must agree with what the loader's bias auto-detect
+        # finds on disk.
+        attn_bias=bool(getattr(hf, "attention_bias", False)),
         mlp_bias=False,
         tie_word_embeddings=getattr(hf, "tie_word_embeddings", False),
         dtype=dtype,
@@ -57,10 +61,12 @@ def load_params(
     def lin(attr, key):
         # q/k store [L, out, in] (decoder.param_specs) — the torch Linear
         # disk layout is already [out, in], so they load untransposed.
+        # bias=True auto-detects: Llama checkpoints carry none; Qwen2
+        # (which delegates here) has q/k/v biases but no o/mlp biases.
         return stacked_linear(
             ckpt, lambda i: f"{layers}.{i}.{attr}", L, mesh,
-            specs["blocks"][key].w, None,
-            transpose=key not in ("q", "k"), bias=False,
+            specs["blocks"][key].w, specs["blocks"][key].b,
+            transpose=key not in ("q", "k"), bias=True,
         )
 
     blocks: Params = {

@@ -1,0 +1,53 @@
+"""Qwen2/Qwen2.5: the Llama block with q/k/v biases (no o/MLP bias).
+
+Checkpoint layout matches Llama's module names, so loading delegates to
+``llama.load_params`` (whose bias auto-detection picks up the q/k/v
+biases); the config difference is the split attention-bias granularity
+(``attn_bias=True`` with ``attn_out_bias=False`` — see
+``DecoderConfig.o_bias``) plus tied embeddings on the small variants.
+Sliding-window attention rides the same implementation as Mistral when
+the checkpoint enables it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from llmss_tpu.models import llama
+from llmss_tpu.models.common import DecoderConfig
+
+
+def config_from_hf(hf, dtype: str = "bfloat16") -> DecoderConfig:
+    cfg = llama.config_from_hf(hf, dtype=dtype)
+    window = None
+    if getattr(hf, "use_sliding_window", False):
+        # HF applies full attention to the bottom ``max_window_layers``
+        # layers and the window only above them. The shared decoder's
+        # window is uniform, so only the two uniform cases load: all
+        # layers full (the common shipped config: max_window_layers ==
+        # num_hidden_layers) or all layers windowed. A mixed config must
+        # not load with silently divergent logits.
+        full_layers = getattr(
+            hf, "max_window_layers", hf.num_hidden_layers
+        )
+        if full_layers >= hf.num_hidden_layers:
+            window = None
+        elif full_layers == 0:
+            window = getattr(hf, "sliding_window", None)
+        else:
+            raise NotImplementedError(
+                "Qwen2 per-layer sliding-window mix "
+                f"(max_window_layers={full_layers} of "
+                f"{hf.num_hidden_layers}) is not supported — the decoder "
+                "applies one window uniformly"
+            )
+    return dataclasses.replace(
+        cfg,
+        model_type="qwen2",
+        attn_bias=True,
+        attn_out_bias=False,
+        sliding_window=window,
+    )
+
+
+load_params = llama.load_params

@@ -12,7 +12,9 @@ from pathlib import Path
 
 from jax.sharding import Mesh
 
-from llmss_tpu.models import gpt2, gpt_bigcode, gptj, llama, mistral
+from llmss_tpu.models import (
+    gpt2, gpt_bigcode, gpt_neox, gptj, llama, mistral, qwen2,
+)
 from llmss_tpu.models.common import DecoderConfig
 from llmss_tpu.models.decoder import Params
 from llmss_tpu.weights import CheckpointShards, weight_files
@@ -23,6 +25,8 @@ MODEL_REGISTRY = {
     "gpt2": gpt2,
     "llama": llama,
     "mistral": mistral,
+    "qwen2": qwen2,
+    "gpt_neox": gpt_neox,
 }
 
 

@@ -62,6 +62,22 @@ def _save_hf(tmp_path, model_type):
             sliding_window=6, tie_word_embeddings=False,
         )
         model = tr.MistralForCausalLM(cfg)
+    elif model_type == "qwen2":
+        cfg = tr.Qwen2Config(
+            vocab_size=64, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            intermediate_size=48, max_position_embeddings=32,
+            tie_word_embeddings=False,
+        )
+        model = tr.Qwen2ForCausalLM(cfg)
+    elif model_type == "gpt_neox":
+        cfg = tr.GPTNeoXConfig(
+            vocab_size=64, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=48,
+            max_position_embeddings=32, rotary_pct=0.25,
+            use_parallel_residual=True,
+        )
+        model = tr.GPTNeoXForCausalLM(cfg)
     else:
         raise KeyError(model_type)
     model.eval()
@@ -78,7 +94,8 @@ def _hf_logits(model, ids):
 
 
 @pytest.mark.parametrize(
-    "model_type", ["gptj", "gpt_bigcode", "gpt2", "llama", "mistral"]
+    "model_type",
+    ["gptj", "gpt_bigcode", "gpt2", "llama", "mistral", "qwen2", "gpt_neox"]
 )
 def test_full_forward_parity(tmp_path, devices, model_type):
     d, hf_model = _save_hf(tmp_path, model_type)
@@ -107,7 +124,9 @@ def test_full_forward_parity(tmp_path, devices, model_type):
     )
 
 
-@pytest.mark.parametrize("model_type", ["gptj", "llama", "mistral"])
+@pytest.mark.parametrize(
+    "model_type", ["gptj", "llama", "mistral", "qwen2", "gpt_neox"]
+)
 def test_incremental_decode_parity(tmp_path, devices, model_type):
     """Prefill then token-by-token decode must equal the full forward."""
     d, hf_model = _save_hf(tmp_path, model_type)
