@@ -44,6 +44,11 @@ class _Row:
     # cancel) — so the serving layer can answer honestly instead of
     # disguising a cancelled request as a success.
     done_cb: Callable[..., None]
+    # Optional per-increment hook: called with the NEW tokens after each
+    # scheduler step that produced any (streaming delivery; granularity is
+    # the decode chunk).
+    stream_cb: Callable[[list[int]], None] | None = None
+    emitted: int = 0
 
 
 @dataclasses.dataclass
@@ -52,7 +57,7 @@ class _InFlightAdmission:
     first tokens have not been fetched: resolved (rows activated) at the
     top of the next step, overlapping admission with the decode chunk."""
 
-    taken: list  # [(req_id, ids, gen, cb, t_submit)]
+    taken: list  # [(req_id, ids, gen, cb, stream_cb, t_submit)]
     rows: list[int]
     tok: jax.Array  # [P] first sampled token per admission row (device)
 
@@ -185,11 +190,13 @@ class ContinuousBatcher:
         gen: GenerationParams,
         done_cb: Callable[[list[int]], None],
         req_id: str = "",
+        stream_cb: Callable[[list[int]], None] | None = None,
     ) -> None:
         gen.validate()
         with self._lock:
             self.pending.append(
-                (req_id, list(token_ids), gen, done_cb, time.perf_counter())
+                (req_id, list(token_ids), gen, done_cb, stream_cb,
+                 time.perf_counter())
             )
 
     # -- scheduling ---------------------------------------------------------
@@ -223,13 +230,13 @@ class ContinuousBatcher:
         while P < n:
             P *= 2
         S = _bucket(
-            max(len(ids) for _rid, ids, _g, _cb, _t in taken),
+            max(len(ids) for _rid, ids, _g, _cb, _scb, _t in taken),
             self.engine.max_seq_len,
         )
         padded = np.zeros((P, S), np.int32)
         lens = np.ones(P, np.int32)  # dummy rows prefill one pad token
         gens = []
-        for i, (_rid, ids, gen, _cb, _t) in enumerate(taken):
+        for i, (_rid, ids, gen, _cb, _scb, _t) in enumerate(taken):
             padded[i, : len(ids)] = ids
             lens[i] = len(ids)
             gens.append(gen)
@@ -258,10 +265,11 @@ class ContinuousBatcher:
         now = time.perf_counter()
         cancelled = self._cancel_at_resolve
         self._cancel_at_resolve = set()
-        for i, (req_id, ids, gen, cb, t_submit) in enumerate(adm.taken):
+        for i, (req_id, ids, gen, cb, scb, t_submit) in enumerate(adm.taken):
             row = adm.rows[i]
             r = _Row(
-                req_id=req_id, gen=gen, out=[], cur_pos=len(ids), done_cb=cb
+                req_id=req_id, gen=gen, out=[], cur_pos=len(ids),
+                done_cb=cb, stream_cb=scb,
             )
             if req_id in cancelled:
                 # Not served, no TTFT sample — matches the static Worker's
@@ -287,16 +295,27 @@ class ContinuousBatcher:
             self.active[row] = r
             if len(r.out) >= r.gen.max_new_tokens:
                 self._finish(row, r)
+            else:
+                # First token goes out now, not a full chunk later —
+                # streaming's perceived TTFT is the point.
+                self._flush_stream(r)
         return len(adm.taken)
 
     def _finish(self, row: int, r: _Row, cancelled: bool = False) -> None:
         self.active.pop(row, None)
         with self._lock:
             self._free.append(row)
+        self._flush_stream(r)
         if cancelled:
             r.done_cb(r.out, True)
         else:
             r.done_cb(r.out)
+
+    @staticmethod
+    def _flush_stream(r: _Row) -> None:
+        if r.stream_cb is not None and len(r.out) > r.emitted:
+            r.stream_cb(r.out[r.emitted:])
+            r.emitted = len(r.out)
 
     def cancel(self, req_id: str) -> None:
         """Mark a request cancelled (thread-safe). The worker thread frees
@@ -321,7 +340,7 @@ class ContinuousBatcher:
             dropped = [p for p in self.pending if p[0] in ids]
             self.pending = deque(p for p in self.pending if p[0] not in ids)
         n = len(dropped)
-        for _rid, _ids, _gen, cb, _t in dropped:
+        for _rid, _ids, _gen, cb, _scb, _t in dropped:
             cb([], True)
         if self._inflight is not None:
             for req_id, *_rest in self._inflight.taken:
@@ -457,6 +476,7 @@ class ContinuousBatcher:
             else:
                 # Survived the whole chunk: device advanced it k steps.
                 self._tokens[i] = int(toks_np[i, k - 1])
+                self._flush_stream(r)
         self._step_count += 1
         self.engine.metrics.add_tokens(n)
         return n

@@ -53,6 +53,24 @@ class Broker(abc.ABC):
         """Subset of ``request_ids`` whose cancellation flag is set."""
         return set()
 
+    # Streaming channel: for ``stream=True`` requests, workers push token
+    # increments as they decode (one entry per chunk); the producer drains
+    # them into SSE events. The terminal GenerateResponse still closes the
+    # request via the response channel. No reference analogue — the
+    # reference delivers only whole continuations.
+    def push_stream(self, request_id: str, token_ids: list[int]) -> None:  # noqa: B027
+        pass
+
+    def pop_stream(
+        self, request_id: str, timeout: float = 0.0
+    ) -> list[int] | None:
+        """Next token increment for the request, or None on timeout."""
+        return None
+
+    def drop_stream(self, request_id: str) -> None:  # noqa: B027
+        """Discard the request's stream channel (producer cleanup on
+        done/cancel/disconnect); later pushes for the id are dropped."""
+
     # Workers publish their metrics snapshot through the broker so the
     # producer can serve GET /metrics even when producer and consumer are
     # separate processes (the reference has no metrics surface at all,
@@ -87,6 +105,39 @@ class InProcBroker(Broker):
         self._metrics: dict = {}
         self._cancels: dict[str, float] = {}  # id -> flag deadline
         self._cancel_lock = threading.Lock()
+        self._streams: dict[str, queue.Queue] = {}
+        self._dead_streams: dict[str, float] = {}  # id -> tombstone expiry
+        self._stream_lock = threading.Lock()
+
+    def push_stream(self, request_id: str, token_ids: list[int]) -> None:
+        with self._stream_lock:
+            if request_id in self._dead_streams:
+                return  # consumer flushed after the producer dropped it
+            q = self._streams.setdefault(request_id, queue.Queue())
+        q.put(list(token_ids))
+
+    def pop_stream(
+        self, request_id: str, timeout: float = 0.0
+    ) -> list[int] | None:
+        with self._stream_lock:
+            q = self._streams.setdefault(request_id, queue.Queue())
+        try:
+            return q.get(timeout=timeout) if timeout else q.get_nowait()
+        except queue.Empty:
+            return None
+
+    def drop_stream(self, request_id: str) -> None:
+        # Tombstone the id so a worker flush racing this drop can't
+        # resurrect the queue (it would leak forever in a long-lived
+        # process); tombstones age out like cancellation flags.
+        now = time.monotonic()
+        with self._stream_lock:
+            self._streams.pop(request_id, None)
+            self._dead_streams[request_id] = now + self.CANCEL_TTL_S
+            for rid in [
+                r for r, t in self._dead_streams.items() if t <= now
+            ]:
+                del self._dead_streams[rid]
 
     def cancel_request(self, request_id: str) -> None:
         with self._cancel_lock:
@@ -152,6 +203,29 @@ class RedisBroker(Broker):
         self._rq = request_queue
         self._prefix = response_prefix
         self._cancel_prefix = cancel_prefix
+
+    def push_stream(self, request_id: str, token_ids: list[int]) -> None:
+        import json
+
+        key = f"stream:{request_id}"
+        self._r.lpush(key, json.dumps(token_ids))
+        self._r.expire(key, 600)
+
+    def pop_stream(
+        self, request_id: str, timeout: float = 0.0
+    ) -> list[int] | None:
+        import json
+
+        key = f"stream:{request_id}"
+        if timeout:
+            item = self._r.brpop(key, timeout=timeout)
+            payload = item[1] if item else None
+        else:
+            payload = self._r.rpop(key)
+        return json.loads(payload) if payload else None
+
+    def drop_stream(self, request_id: str) -> None:
+        self._r.delete(f"stream:{request_id}")
 
     def cancel_request(self, request_id: str) -> None:
         # Keyed TTL flag, not a queue entry: every worker can see it, and

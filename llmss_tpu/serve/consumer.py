@@ -277,7 +277,14 @@ class ContinuousWorker:
                     )
                 )
 
-            self.batcher.submit(ids, gen, cb, req_id=req.id)
+            stream_cb = None
+            if req.stream:
+                def stream_cb(new_toks, req=req):
+                    self.broker.push_stream(req.id, new_toks)
+
+            self.batcher.submit(
+                ids, gen, cb, req_id=req.id, stream_cb=stream_cb
+            )
             n += 1
 
     def run_once(self) -> int:

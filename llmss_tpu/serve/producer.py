@@ -51,6 +51,63 @@ class ProducerServer:
                 else:
                     self._reply(404, {"error": "not found"})
 
+            def _stream_response(self, req):
+                """SSE delivery for ``stream: true`` requests: one
+                ``data:`` event per token increment as the worker decodes
+                (granularity = its chunk), then a ``done`` event carrying
+                the terminal response. HTTP/1.0 close-delimited body — no
+                chunked-encoding bookkeeping. The reference can only
+                deliver whole continuations."""
+                import time as _time
+
+                outer.broker.push_request(req)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                deadline = _time.monotonic() + outer.timeout_s
+                try:
+                    while _time.monotonic() < deadline:
+                        inc = outer.broker.pop_stream(req.id, timeout=0.1)
+                        if inc is not None:
+                            self.wfile.write(
+                                b"data: "
+                                + json.dumps({"token_ids": inc}).encode()
+                                + b"\n\n"
+                            )
+                            self.wfile.flush()
+                            continue
+                        resp = outer.broker.wait_response(
+                            req.id, timeout=0.05
+                        )
+                        if resp is not None:
+                            # Drain increments that raced the response.
+                            while True:
+                                inc = outer.broker.pop_stream(req.id)
+                                if inc is None:
+                                    break
+                                self.wfile.write(
+                                    b"data: "
+                                    + json.dumps(
+                                        {"token_ids": inc}
+                                    ).encode() + b"\n\n"
+                                )
+                            self.wfile.write(
+                                b"event: done\ndata: "
+                                + resp.to_json().encode() + b"\n\n"
+                            )
+                            self.wfile.flush()
+                            return
+                    outer.broker.cancel_request(req.id)
+                    self.wfile.write(
+                        b'event: error\ndata: {"error": "timed out"}\n\n'
+                    )
+                except (BrokenPipeError, ConnectionResetError):
+                    # Client went away mid-stream: stop decoding for it.
+                    outer.broker.cancel_request(req.id)
+                finally:
+                    outer.broker.drop_stream(req.id)
+
             def do_POST(self):
                 if self.path == "/cancel":
                     try:
@@ -71,6 +128,9 @@ class ProducerServer:
                     req.validate()
                 except Exception as e:  # noqa: BLE001 — client error surface
                     self._reply(400, {"error": str(e)})
+                    return
+                if req.stream:
+                    self._stream_response(req)
                     return
                 outer.broker.push_request(req)
                 resp = outer.broker.wait_response(req.id, outer.timeout_s)
@@ -157,6 +217,14 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0):
             req.validate()
         except ValueError as e:
             raise HTTPException(400, str(e)) from e
+        if req.stream:
+            # SSE streaming lives on the stdlib ProducerServer; answering
+            # a stream request with plain JSON would silently break the
+            # client's parser.
+            raise HTTPException(
+                400, "stream=true is not supported by the FastAPI "
+                     "producer variant; use ProducerServer"
+            )
         broker.push_request(req)
         resp = broker.wait_response(req.id, timeout_s)
         if resp is None:

@@ -24,6 +24,10 @@ class GenerateRequest:
     top_p: float = 1.0
     top_k: int = 0
     seed: int = 0
+    # stream=True: tokens are delivered incrementally over the broker's
+    # stream channel (producer serves them as SSE events) as they decode;
+    # the final GenerateResponse still closes the request.
+    stream: bool = False
     id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
 
     def to_json(self) -> str:

@@ -322,3 +322,103 @@ def test_health_flips_on_stale_heartbeat(serving):
     r = httpx.get(f"http://127.0.0.1:{server.port}/health", timeout=10)
     assert r.status_code == 503
     assert r.json()["status"] == "no-heartbeat-data"
+
+
+def test_two_workers_share_one_broker(serving):
+    """Multi-consumer topology (what RedisBroker exists for): two workers
+    draining one queue must serve disjoint requests correctly, and a
+    cancellation must reach the worker that owns the request — the TTL'd
+    flag is readable by all workers, not competitively consumed by
+    whichever polls first."""
+    _, engine = serving
+    broker = InProcBroker()
+    w1 = ContinuousWorker(engine, broker, rows=2, poll_timeout_s=0.01,
+                          chunk_steps=2)
+    w2 = ContinuousWorker(engine, broker, rows=2, poll_timeout_s=0.01,
+                          chunk_steps=2)
+
+    ids = []
+    for i in range(6):
+        rid = f"mw{i}"
+        broker.push_request(GenerateRequest(
+            id=rid, token_ids=[1 + i, 2, 3], max_new_tokens=4,
+            is_greedy=True,
+        ))
+        ids.append(rid)
+    # A long request that will be cancelled mid-flight; either worker may
+    # own it.
+    broker.push_request(GenerateRequest(
+        id="mw-long", token_ids=[9, 9], max_new_tokens=200, is_greedy=True,
+    ))
+
+    # Interleave the two workers; cancel the long request once it is
+    # somewhere in the system.
+    for step in range(6):
+        w1.run_once()
+        w2.run_once()
+    broker.cancel_request("mw-long")
+
+    deadline = time.time() + 120
+    got = {}
+    while len(got) < 7 and time.time() < deadline:
+        w1.run_once()
+        w2.run_once()
+        for rid in ids + ["mw-long"]:
+            if rid not in got:
+                r = broker.wait_response(rid, timeout=0.001)
+                if r is not None:
+                    got[rid] = r
+    assert set(got) == set(ids) | {"mw-long"}, sorted(got)
+    for rid in ids:
+        assert got[rid].error is None and len(got[rid].token_ids) == 4
+    assert got["mw-long"].error == "cancelled"
+    assert len(got["mw-long"].token_ids or []) < 200
+
+
+def test_streaming_sse_roundtrip(serving):
+    """stream: true delivers token increments as SSE events while the
+    request decodes (continuous worker), then a done event with the full
+    response; tokens concatenate to exactly the non-streamed result."""
+    _, engine = serving
+    broker = InProcBroker()
+    worker = ContinuousWorker(engine, broker, rows=2, poll_timeout_s=0.01,
+                              chunk_steps=2)
+    stop = threading.Event()
+    t = threading.Thread(target=worker.run_forever, args=(stop,),
+                         daemon=True)
+    t.start()
+    server = ProducerServer(broker, host="127.0.0.1", port=0, timeout_s=60)
+    server.start()
+    try:
+        ref = _post(server.port, {
+            "token_ids": [5, 6, 7], "max_new_tokens": 12, "is_greedy": True,
+        }).json()["token_ids"]
+
+        events, done = [], None
+        with httpx.stream(
+            "POST", f"http://127.0.0.1:{server.port}/generate",
+            json={"token_ids": [5, 6, 7], "max_new_tokens": 12,
+                  "is_greedy": True, "stream": True},
+            timeout=60,
+        ) as r:
+            assert r.status_code == 200
+            assert "text/event-stream" in r.headers["content-type"]
+            cur_event = None
+            for line in r.iter_lines():
+                if line.startswith("event:"):
+                    cur_event = line.split(":", 1)[1].strip()
+                elif line.startswith("data:"):
+                    payload = json.loads(line.split(":", 1)[1])
+                    if cur_event == "done":
+                        done = payload
+                    elif cur_event is None:
+                        events.append(payload["token_ids"])
+                    cur_event = None
+
+        assert done is not None and done["error"] is None
+        streamed = [t for inc in events for t in inc]
+        assert len(events) >= 2  # actually incremental, not one blob
+        assert streamed == ref == done["token_ids"]
+    finally:
+        stop.set()
+        server.stop()
