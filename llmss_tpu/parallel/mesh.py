@@ -62,6 +62,25 @@ def initialize_runtime(
     global _initialized
     if _initialized:
         return
+    # Persistent XLA compilation cache: the serving prewarm compiles the
+    # whole executable envelope (~2 min at 1B scale); with the cache a
+    # restarted worker reloads those executables in seconds instead of
+    # recompiling. Opt out with LLMSS_COMPILE_CACHE=0 or point it
+    # elsewhere with LLMSS_COMPILE_CACHE=/path.
+    cache_dir = os.environ.get("LLMSS_COMPILE_CACHE")
+    if cache_dir != "0":
+        if not cache_dir:
+            cache_dir = os.path.join(
+                os.path.expanduser("~"), ".cache", "llmss_tpu", "xla"
+            )
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0
+            )
+        except Exception:  # noqa: BLE001 — cache is an optimization only
+            pass
     explicit = coordinator_address is not None or num_processes is not None
     in_multiprocess_env = explicit or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if in_multiprocess_env:

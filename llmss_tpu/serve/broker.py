@@ -188,6 +188,9 @@ class InProcBroker(Broker):
 class RedisBroker(Broker):
     """Wire-compatible with the reference's Redis lists, id-corrected.
 
+    Requires Redis >= 6.0: the streaming/response paths use fractional
+    BLPOP/BRPOP timeouts, which older servers reject.
+
     Requests ride the ``pqueue`` list as JSON (same as
     ``producer_server.py:47-48``); responses go to per-request keys
     ``squeue:{id}`` (BLPOP-able) instead of one shared ``squeue``, fixing the

@@ -181,6 +181,12 @@ class Worker:
             return len(batch)
 
         for req, toks in zip(ok, outs):
+            if req.stream:
+                # The batch worker has no per-chunk hook; degrade to one
+                # increment at completion so SSE clients still get their
+                # data event before done (use --continuous for true
+                # incremental delivery).
+                self.broker.push_stream(req.id, toks)
             if req.id in mid_cancelled:
                 # The client is by definition gone — an honest "cancelled"
                 # error (with the partial tokens), not a fake success.

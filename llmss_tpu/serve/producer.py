@@ -58,6 +58,7 @@ class ProducerServer:
                 the terminal response. HTTP/1.0 close-delimited body — no
                 chunked-encoding bookkeeping. The reference can only
                 deliver whole continuations."""
+                import socket as _socket
                 import time as _time
 
                 outer.broker.push_request(req)
@@ -65,16 +66,26 @@ class ProducerServer:
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.end_headers()
+                # A stalled reader must not pin this handler thread: once
+                # the socket send buffer fills, an untimed write would
+                # block forever and the deadline/cancel logic below could
+                # never run. A write timeout makes a stalled client look
+                # like a disconnect.
+                self.connection.settimeout(30.0)
+
+                def write_data(inc):
+                    self.wfile.write(
+                        b"data: " + json.dumps(
+                            {"token_ids": inc}
+                        ).encode() + b"\n\n"
+                    )
+
                 deadline = _time.monotonic() + outer.timeout_s
                 try:
                     while _time.monotonic() < deadline:
                         inc = outer.broker.pop_stream(req.id, timeout=0.1)
                         if inc is not None:
-                            self.wfile.write(
-                                b"data: "
-                                + json.dumps({"token_ids": inc}).encode()
-                                + b"\n\n"
-                            )
+                            write_data(inc)
                             self.wfile.flush()
                             continue
                         resp = outer.broker.wait_response(
@@ -86,12 +97,7 @@ class ProducerServer:
                                 inc = outer.broker.pop_stream(req.id)
                                 if inc is None:
                                     break
-                                self.wfile.write(
-                                    b"data: "
-                                    + json.dumps(
-                                        {"token_ids": inc}
-                                    ).encode() + b"\n\n"
-                                )
+                                write_data(inc)
                             self.wfile.write(
                                 b"event: done\ndata: "
                                 + resp.to_json().encode() + b"\n\n"
@@ -102,8 +108,12 @@ class ProducerServer:
                     self.wfile.write(
                         b'event: error\ndata: {"error": "timed out"}\n\n'
                     )
-                except (BrokenPipeError, ConnectionResetError):
-                    # Client went away mid-stream: stop decoding for it.
+                except (
+                    BrokenPipeError, ConnectionResetError,
+                    TimeoutError, _socket.timeout,
+                ):
+                    # Client went away (or stopped reading) mid-stream:
+                    # stop decoding for it.
                     outer.broker.cancel_request(req.id)
                 finally:
                     outer.broker.drop_stream(req.id)

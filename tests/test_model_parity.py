@@ -125,7 +125,8 @@ def test_full_forward_parity(tmp_path, devices, model_type):
 
 
 @pytest.mark.parametrize(
-    "model_type", ["gptj", "llama", "mistral", "qwen2", "gpt_neox"]
+    "model_type",
+    ["gptj", "gpt_bigcode", "gpt2", "llama", "mistral", "qwen2", "gpt_neox"],
 )
 def test_incremental_decode_parity(tmp_path, devices, model_type):
     """Prefill then token-by-token decode must equal the full forward."""
