@@ -43,6 +43,10 @@ class DecoderConfig:
     activation: str = "gelu_new"  # ACT2FN key (gptj_modeling.py:266)
     norm: str = "layernorm"  # "layernorm" | "rmsnorm"
     norm_eps: float = 1e-5
+    # Gemma parameterizes RMSNorm as (1 + weight) and scales embeddings by
+    # sqrt(hidden_size) before the first block.
+    norm_scale_offset: float = 0.0
+    embed_multiplier: float | None = None
     parallel_residual: bool = False  # GPT-J block form
     # GPT-NeoX variant of the parallel block: the MLP branch gets its own
     # pre-norm (h + attn(ln1(h)) + mlp(ln2(h))) instead of sharing GPT-J's

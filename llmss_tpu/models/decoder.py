@@ -213,7 +213,7 @@ def _norm(cfg: DecoderConfig, x, p: NormParams):
     from llmss_tpu.ops.layers import layer_norm, rms_norm
 
     if cfg.norm == "rmsnorm":
-        return rms_norm(x, p, cfg.norm_eps)
+        return rms_norm(x, p, cfg.norm_eps, cfg.norm_scale_offset)
     return layer_norm(x, p, cfg.norm_eps)
 
 
@@ -471,6 +471,10 @@ def forward(
     # where a gather reads B·E floats.
     one_hot = input_ids.shape[1] > 1
     h = embedding(input_ids, params["wte"].astype(dtype), one_hot=one_hot)
+    if cfg.embed_multiplier is not None:
+        # Gemma scales hidden states by sqrt(hidden_size) post-embedding
+        # (cast-then-scale order matches HF's bf16 reference).
+        h = h * jnp.asarray(cfg.embed_multiplier, dtype)
     if cfg.positions == "learned":
         h = h + embedding(
             positions, params["wpe"].astype(dtype), one_hot=one_hot

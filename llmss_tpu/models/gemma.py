@@ -1,0 +1,35 @@
+"""Gemma (v1): Llama layout with (1 + w) RMSNorm and scaled embeddings.
+
+Checkpoint module names match Llama's, so loading delegates wholesale
+(the tied head falls out of ``tie_word_embeddings`` — Gemma always ties).
+Model-level differences carried by config: RMSNorm parameterized as
+``(1 + weight)`` (``norm_scale_offset``), hidden states scaled by
+``sqrt(hidden_size)`` after the embedding (``embed_multiplier``), and the
+tanh-approximated GELU MLP.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from llmss_tpu.models import llama
+from llmss_tpu.models.common import DecoderConfig
+
+
+def config_from_hf(hf, dtype: str = "bfloat16") -> DecoderConfig:
+    cfg = llama.config_from_hf(hf, dtype=dtype)
+    return dataclasses.replace(
+        cfg,
+        model_type="gemma",
+        # HF's GemmaMLP deliberately ignores hidden_act and forces the
+        # tanh GELU whenever hidden_activation is unset — old hub configs
+        # say hidden_act="gelu" but mean the tanh approximation.
+        activation=getattr(hf, "hidden_activation", None)
+        or "gelu_pytorch_tanh",
+        norm_scale_offset=1.0,
+        embed_multiplier=float(hf.hidden_size) ** 0.5,
+        tie_word_embeddings=True,
+    )
+
+
+load_params = llama.load_params

@@ -52,8 +52,13 @@ def config_from_hf(hf, dtype: str = "bfloat16") -> DecoderConfig:
 
 
 def load_params(
-    ckpt: CheckpointShards, cfg: DecoderConfig, mesh: Mesh
+    ckpt: CheckpointShards, cfg: DecoderConfig, mesh: Mesh,
+    overrides=None,
 ) -> Params:
+    """``overrides`` maps a block key ("q", "gate", …) to a
+    ``(ckpt, cfg, mesh, specs) -> LinearParams`` factory — how families
+    with Llama-identical structure but fused checkpoint tensors (Phi-3)
+    reuse this loader instead of copying it."""
     specs = param_specs(cfg, mesh.shape[AXIS_TP])
     L = cfg.n_layers
     layers = "model.layers"
@@ -69,6 +74,11 @@ def load_params(
             transpose=key not in ("q", "k"), bias=True,
         )
 
+    def entry(attr, key):
+        if overrides and key in overrides:
+            return overrides[key](ckpt, cfg, mesh, specs)
+        return lin(attr, key)
+
     blocks: Params = {
         "ln1": stacked_norm(
             ckpt, lambda i: f"{layers}.{i}.input_layernorm", L, mesh,
@@ -78,13 +88,13 @@ def load_params(
             ckpt, lambda i: f"{layers}.{i}.post_attention_layernorm", L, mesh,
             bias=False,
         ),
-        "q": lin("self_attn.q_proj", "q"),
-        "k": lin("self_attn.k_proj", "k"),
-        "v": lin("self_attn.v_proj", "v"),
-        "o": lin("self_attn.o_proj", "o"),
-        "gate": lin("mlp.gate_proj", "gate"),
-        "up": lin("mlp.up_proj", "up"),
-        "down": lin("mlp.down_proj", "down"),
+        "q": entry("self_attn.q_proj", "q"),
+        "k": entry("self_attn.k_proj", "k"),
+        "v": entry("self_attn.v_proj", "v"),
+        "o": entry("self_attn.o_proj", "o"),
+        "gate": entry("mlp.gate_proj", "gate"),
+        "up": entry("mlp.up_proj", "up"),
+        "down": entry("mlp.down_proj", "down"),
     }
     params: Params = {
         "wte": ckpt.get_array(

@@ -1,0 +1,76 @@
+"""Phi-3 / Phi-3.5: the Llama block with fused qkv_proj and gate_up_proj.
+
+Unlike GPT-NeoX's head-interleaved packing, Phi-3's fused tensors are
+plain contiguous blocks — ``qkv_proj`` is Q|K|V on the output axis and
+``gate_up_proj`` is gate|up — so they split with the same per-shard
+sub-range sliced reads GPT-2 uses for ``c_attn`` (each rank still touches
+only its own bytes); loading otherwise delegates to the Llama loader via
+its ``overrides`` hook. Partial rotary (``partial_rotary_factor``,
+Phi-4-mini) is honored; LongRoPE-scaled checkpoints (Phi-3-*-128k /
+Phi-3.5: ``rope_scaling.type == "longrope"``) are **rejected** rather
+than loaded with silently wrong frequencies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from jax.sharding import Mesh
+
+from llmss_tpu.models import llama
+from llmss_tpu.models._loading import stacked_linear
+from llmss_tpu.models.common import DecoderConfig
+from llmss_tpu.models.decoder import Params
+from llmss_tpu.weights.loader import CheckpointShards
+
+
+def config_from_hf(hf, dtype: str = "bfloat16") -> DecoderConfig:
+    if getattr(hf, "rope_scaling", None):
+        raise NotImplementedError(
+            "Phi-3 rope_scaling (LongRoPE) is not implemented; loading "
+            "would produce wrong logits at every position. Supported: "
+            "the 4k-context Phi-3 variants with plain rotary."
+        )
+    cfg = llama.config_from_hf(hf, dtype=dtype)
+    head_dim = cfg.head_dim
+    return dataclasses.replace(
+        cfg,
+        model_type="phi3",
+        rotary_dim=int(
+            head_dim * getattr(hf, "partial_rotary_factor", 1.0)
+        ),
+        sliding_window=getattr(hf, "sliding_window", None),
+    )
+
+
+def _fused(attr: str, key: str, lo: int, hi: int):
+    """Override factory splitting a contiguous fused tensor by sub-range
+    sliced reads. q/k read the stored-transposed [L, out, in] view (range
+    on logical axis 0); v/gate/up read [L, in, out] (range on the
+    transposed output axis 1)."""
+
+    def load(ckpt: CheckpointShards, cfg, mesh: Mesh, specs) -> Params:
+        t = key in ("q", "k")
+        return stacked_linear(
+            ckpt, lambda i: f"model.layers.{i}.{attr}", cfg.n_layers, mesh,
+            specs["blocks"][key].w, specs["blocks"][key].b,
+            transpose=not t, sub=(0 if t else 1, lo, hi), bias=True,
+        )
+
+    return load
+
+
+def load_params(
+    ckpt: CheckpointShards, cfg: DecoderConfig, mesh: Mesh
+) -> Params:
+    Q, KV, I = cfg.q_size, cfg.kv_size, cfg.intermediate_size
+    return llama.load_params(
+        ckpt, cfg, mesh,
+        overrides={
+            "q": _fused("self_attn.qkv_proj", "q", 0, Q),
+            "k": _fused("self_attn.qkv_proj", "k", Q, Q + KV),
+            "v": _fused("self_attn.qkv_proj", "v", Q + KV, Q + 2 * KV),
+            "gate": _fused("mlp.gate_up_proj", "gate", 0, I),
+            "up": _fused("mlp.gate_up_proj", "up", I, 2 * I),
+        },
+    )

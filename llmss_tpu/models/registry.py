@@ -13,7 +13,7 @@ from pathlib import Path
 from jax.sharding import Mesh
 
 from llmss_tpu.models import (
-    gpt2, gpt_bigcode, gpt_neox, gptj, llama, mistral, qwen2,
+    gemma, gpt2, gpt_bigcode, gpt_neox, gptj, llama, mistral, phi3, qwen2,
 )
 from llmss_tpu.models.common import DecoderConfig
 from llmss_tpu.models.decoder import Params
@@ -27,6 +27,8 @@ MODEL_REGISTRY = {
     "mistral": mistral,
     "qwen2": qwen2,
     "gpt_neox": gpt_neox,
+    "phi3": phi3,
+    "gemma": gemma,
 }
 
 

@@ -113,11 +113,14 @@ def layer_norm(x: jax.Array, p: NormParams, eps: float) -> jax.Array:
     return y.astype(x.dtype)
 
 
-def rms_norm(x: jax.Array, p: NormParams, eps: float) -> jax.Array:
-    """RMSNorm (Llama-family; no reference equivalent — new capability)."""
+def rms_norm(
+    x: jax.Array, p: NormParams, eps: float, scale_offset: float = 0.0
+) -> jax.Array:
+    """RMSNorm (Llama-family; no reference equivalent — new capability).
+    ``scale_offset`` implements Gemma's (1 + weight) parameterization."""
     xf = x.astype(jnp.float32)
     y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
-    y = y * p.scale.astype(jnp.float32)
+    y = y * (p.scale.astype(jnp.float32) + scale_offset)
     return y.astype(x.dtype)
 
 

@@ -78,6 +78,21 @@ def _save_hf(tmp_path, model_type):
             use_parallel_residual=True,
         )
         model = tr.GPTNeoXForCausalLM(cfg)
+    elif model_type == "phi3":
+        cfg = tr.Phi3Config(
+            vocab_size=64, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            intermediate_size=48, max_position_embeddings=32,
+            tie_word_embeddings=False, pad_token_id=0,
+        )
+        model = tr.Phi3ForCausalLM(cfg)
+    elif model_type == "gemma":
+        cfg = tr.GemmaConfig(
+            vocab_size=64, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=1, head_dim=8,
+            intermediate_size=48, max_position_embeddings=32,
+        )
+        model = tr.GemmaForCausalLM(cfg)
     else:
         raise KeyError(model_type)
     model.eval()
@@ -95,7 +110,8 @@ def _hf_logits(model, ids):
 
 @pytest.mark.parametrize(
     "model_type",
-    ["gptj", "gpt_bigcode", "gpt2", "llama", "mistral", "qwen2", "gpt_neox"]
+    ["gptj", "gpt_bigcode", "gpt2", "llama", "mistral", "qwen2", "gpt_neox",
+     "phi3", "gemma"],
 )
 def test_full_forward_parity(tmp_path, devices, model_type):
     d, hf_model = _save_hf(tmp_path, model_type)
@@ -126,7 +142,8 @@ def test_full_forward_parity(tmp_path, devices, model_type):
 
 @pytest.mark.parametrize(
     "model_type",
-    ["gptj", "gpt_bigcode", "gpt2", "llama", "mistral", "qwen2", "gpt_neox"],
+    ["gptj", "gpt_bigcode", "gpt2", "llama", "mistral", "qwen2", "gpt_neox",
+     "phi3", "gemma"],
 )
 def test_incremental_decode_parity(tmp_path, devices, model_type):
     """Prefill then token-by-token decode must equal the full forward."""
