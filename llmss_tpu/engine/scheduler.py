@@ -94,10 +94,21 @@ class ContinuousBatcher:
             donate_argnums=(2,),
         )
 
+    def _pad_row_idx(self, P: int, rows: list[int]) -> np.ndarray:
+        """[P] scatter indices for an admission insert: real rows first,
+        padding filled with a POSITIVE out-of-range sentinel (self.rows).
+        mode="drop" only drops indices that are OOB *after* normalization,
+        and JAX wraps negative indices first — a -1 sentinel would scatter
+        the dummy row into live row rows-1, zeroing its KV."""
+        idx = np.full(P, self.rows, np.int32)
+        idx[: len(rows)] = rows
+        return idx
+
     @staticmethod
     def _insert_impl(big: KVCache, small: KVCache, rows) -> KVCache:
         """Copy scratch-cache rows into the persistent cache at ``rows``
-        ([P] int32; -1 entries are padding and dropped)."""
+        ([P] int32; entries >= big rows are padding and dropped — the
+        sentinel must be positive OOB, since negative indices wrap)."""
         return KVCache(
             k=big.k.at[:, rows].set(small.k, mode="drop"),
             v=big.v.at[:, rows].set(small.v, mode="drop"),
@@ -149,7 +160,8 @@ class ContinuousBatcher:
             # cache-consuming executable has two steady-state signatures.
             for _ in range(2):
                 self.cache = self._insert(
-                    self.cache, scratch, jnp.full(P, -1, np.int32)
+                    self.cache, scratch,
+                    jnp.asarray(self._pad_row_idx(P, [])),
                 )
                 n_compiled += 1
         # Decode step/chunk at the full row count (twice — see above).
@@ -241,8 +253,7 @@ class ContinuousBatcher:
             lens[i] = len(ids)
             gens.append(gen)
         gens += [GenerationParams()] * (P - n)
-        row_idx = np.full(P, -1, np.int32)  # -1 = dropped by the scatter
-        row_idx[:n] = rows
+        row_idx = self._pad_row_idx(P, rows)
 
         scratch = self.engine.new_cache(P)
         sample_args = self.engine._sample_args(gens, P)

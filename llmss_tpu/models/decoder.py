@@ -581,9 +581,13 @@ def forward(
                 mask, mesh=mesh,
             )
             if quant:
-                # Re-quantize the written layer. Exact for untouched slots:
-                # quantize_kv always maps the per-head max to ±127, so a
-                # dequant→quant round trip reproduces the stored int8.
+                # Re-quantize the written layer. NOTE: the dequant above runs
+                # in compute dtype (bf16, 8 mantissa bits), so a dequant→quant
+                # round trip can flip previously stored slots by ±1 — benign
+                # only because prefill always starts from an empty cache
+                # (every valid slot is freshly written this call). Any future
+                # S>1 forward over a populated int8 cache (prefix reuse) must
+                # dequantize in fp32 or skip requantizing untouched slots.
                 k_q, ks_l = quantize_kv(k_l)
                 v_q, vs_l = quantize_kv(v_l)
                 return h, (k_q, v_q, ks_l, vs_l)
