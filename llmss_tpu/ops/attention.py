@@ -113,6 +113,28 @@ def attention(
     return out.reshape(B, S, Hq, D).astype(q.dtype)
 
 
+def decode_mask_penalty(
+    q_pos: jax.Array,  # [B, 1]
+    kv_pos_old: jax.Array,  # [B, T] — pre-write slot positions
+    slots: jax.Array,  # [B, 1] — slot the current token will occupy
+    window: int | None = None,
+) -> jax.Array:
+    """Additive fp32 [B, T] mask for ``fresh_kv_decode_attention``: 0 for
+    visible slots, fp32-min for masked ones (causal, empty, the pending
+    slot, and outside the sliding window). Layer-invariant — compute once
+    per decode step and pass to every layer (see ``penalty`` below)."""
+    T = kv_pos_old.shape[1]
+    slot_idx = jnp.arange(T, dtype=jnp.int32)
+    mask = (
+        (kv_pos_old <= q_pos)  # q_pos [B, 1] broadcasts over T
+        & (kv_pos_old >= 0)
+        & (slot_idx[None, :] != slots)
+    )  # [B, T]
+    if window is not None:
+        mask &= kv_pos_old > q_pos - window
+    return jnp.where(mask, 0.0, _NEG_INF).astype(jnp.float32)
+
+
 def fresh_kv_decode_attention(
     q: jax.Array,  # [B, 1, Hq, D]
     k_cache: jax.Array,  # [B, T, Hkv, D] — stale (current token NOT written)
@@ -125,6 +147,7 @@ def fresh_kv_decode_attention(
     *,
     scale: float | None = None,
     window: int | None = None,
+    penalty: jax.Array | None = None,  # [B, T] f32 — precomputed mask
 ) -> jax.Array:
     """Decode attention over a stale cache + the fresh current-token KV,
     merged in one exact softmax.
@@ -136,6 +159,13 @@ def fresh_kv_decode_attention(
     current token will occupy is masked out of the cache read — on ring
     wrap this also drops the overwritten token, exactly matching the
     write-then-attend order of the in-scan path.
+
+    ``penalty`` optionally supplies ``decode_mask_penalty(q_pos,
+    kv_pos_old, slots, window)``. The mask depends only on positions —
+    layer-invariant — and the decode scan hoists it: evaluating the
+    boolean chain + ``where`` inside the per-layer score fusion measurably
+    un-fuses the cache read (~0.6 ms/step at bench scale), while a single
+    precomputed additive [B, T] operand keeps the fusion streaming.
     """
     B, S, Hq, D = q.shape
     T, Hkv = k_cache.shape[1], k_cache.shape[2]
@@ -145,15 +175,12 @@ def fresh_kv_decode_attention(
 
     qf = q.astype(jnp.float32).reshape(B, S, Hkv, G, D) * scale
     s_c = jnp.einsum("bskgd,btkd->bkgst", qf, k_cache.astype(jnp.float32))
-    slot_idx = jnp.arange(T, dtype=jnp.int32)
-    mask = (
-        (kv_pos_old[:, None, :] <= q_pos[:, :, None])
-        & (kv_pos_old[:, None, :] >= 0)
-        & (slot_idx[None, None, :] != slots[:, :, None])
-    )  # [B, S, T]
-    if window is not None:
-        mask &= kv_pos_old[:, None, :] > q_pos[:, :, None] - window
-    s_c = jnp.where(mask[:, None, None], s_c, _NEG_INF)
+    if penalty is None:
+        penalty = decode_mask_penalty(q_pos, kv_pos_old, slots, window)
+    # Additive masking: exact for the finite-min convention (adding the
+    # fp32 min to any finite score saturates to the min, and max/exp
+    # downstream treat it exactly like the where() it replaces).
+    s_c = s_c + penalty[:, None, None, None, :]
     # Current token always attends itself (finite logit), so an empty cache
     # degenerates cleanly to out = v_new.
     s_s = jnp.einsum(
@@ -164,8 +191,29 @@ def fresh_kv_decode_attention(
     p_c = jnp.exp(s_c - m)
     p_s = jnp.exp(s_s - m)
     denom = jnp.sum(p_c, axis=-1, keepdims=True) + p_s
+    if G == 1 and S == 1:
+        # Value contraction as a hand-written broadcast-multiply + fp32
+        # reduce over t — a MAJOR dim of the [B, T, Hkv, D] cache, so the
+        # VPU loop accumulates whole (Hkv, D) lane-planes and XLA fuses the
+        # decode scan's per-layer V slice (and dtype convert / int8
+        # dequant) into this single pass over the V bytes. Spelled as a
+        # dot_general, V instead rides the materialized slice+transpose
+        # copy the K-score dot needs (~0.3 ms/step at bench scale). The
+        # K side stays a real MXU dot: its contraction is over the minor
+        # d dim, where a VPU mult+reduce is a (slow) cross-lane pattern.
+        p_t = p_c[:, :, 0, 0, :]  # [B, Hkv, T]
+        vterm = jnp.sum(
+            p_t.transpose(0, 2, 1)[..., None]
+            * v_cache.astype(jnp.float32),
+            axis=1,
+        )  # [B, Hkv, D]
+        out_c = vterm[:, :, None, None, :]  # [B, Hkv, 1, 1, D]
+    else:
+        out_c = jnp.einsum(
+            "bkgst,btkd->bkgsd", p_c, v_cache.astype(jnp.float32)
+        )
     out = (
-        jnp.einsum("bkgst,btkd->bkgsd", p_c, v_cache.astype(jnp.float32))
+        out_c
         + p_s * v_new.astype(jnp.float32).transpose(0, 2, 1, 3)[:, :, None]
     ) / denom
     return (

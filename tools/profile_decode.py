@@ -294,14 +294,23 @@ argmax/top-k/top-p with a full-logits-reduction token derivation.
 - The per-layer weight `dot_general`s stream at ~680 GB/s (83% of peak):
   the scan's weight slices are prefetched into alternate memory by XLA
   (the `S(1)` copies in the HLO) and are near the practical ceiling.
-- The `dynamic_slice` x(L·steps) at ~1300 GB/s r+w is the layer scan
-  **copying each layer's KV out of the stacked cache** before attention
-  reads it — ~0.5 ms/step of pure overhead. A Pallas stacked-cache decode
-  kernel (`ops/pallas_decode.py`, scalar-prefetched layer index) removes
-  the copy but measured *slower* overall (6.4 ms/step): 20 per-layer
-  kernel invocations don't pipeline across layer boundaries the way XLA's
-  fused scan does, and the head-minor cache layout forces strided VMEM
-  reads. It stays opt-in (`LLMSS_ATTN_IMPL=pallas`), parity-tested.
+- The attention-over-cache cost (`no_attn` delta) is dominated by the
+  per-layer K slice+transpose copy feeding the score dot plus the masked
+  softmax chain. Round 4 measured the design space exhaustively on-chip
+  (see git history): a head-major `[L,B,Hkv,T,D]` cache makes XLA rewrite
+  the G=1 dots into lane-dim-reduce fusions (379 GB/s — slower); a
+  K-transposed `[L,B,Hkv,D,T]` cache makes the reads fuse at 744 GB/s in
+  isolation but the T-minor column scatter costs ~1.2 ms/step (tile
+  read-modify-write) and real-model fusion breaks re-materialize the
+  copies — net slower. The shipped layout stays seq-major with the V
+  contraction hand-written as a major-dim multiply+reduce, rope sin/cos
+  and the decode mask penalty hoisted out of the layer scan (each breaks
+  the cache-read fusion when computed per layer: +0.67 ms and
+  +0.6 ms/step respectively). A *dynamic* score mask (any mask whose
+  values aren't compile-time constants) costs ~0.6 ms/step over a
+  foldable one — the remaining gap to the stream floor.
+- The post-scan deferred KV scatter now fuses to ~0 marginal cost (the
+  `no_scatter` delta); round 3 measured it at 0.08 ms.
 - IDLE in the trace is host-side gaps of `generate_fused` (tunnel fetch
   latency ~90 ms/call on this host), not device inefficiency — the slope
   method cancels it, `bench.py` measures the same way.
