@@ -123,6 +123,9 @@ class DecodeEngine:
             donate_argnums=(2,),
             static_argnames=("n_steps",),
         )
+        self._admit_merge = jax.jit(
+            self._admit_merge_impl, donate_argnums=(0, 1)
+        )
 
     # -- jitted bodies ------------------------------------------------------
 
@@ -156,6 +159,20 @@ class DecodeEngine:
         )
         tok = sample(logits[:, 0], counters=cur_pos + 1, **sample_args)
         return tok, logits[:, 0], cache
+
+    @staticmethod
+    def _admit_merge_impl(tokens, cur_pos, adm_tok, adm_lens, rows):
+        """Merge an admission batch into the device-resident decode state:
+        ``tokens[rows] = adm_tok`` (each row's prefill-sampled first token)
+        and ``cur_pos[rows] = adm_lens``. ``rows`` is [P] int32 padded with
+        a positive out-of-range sentinel (mode="drop"; negative would wrap
+        — the r3 admission-sentinel bug). This is what lets the scheduler
+        pipeline decode chunks without fetching tokens to the host: the
+        next chunk reads the merged state directly (scheduler.py)."""
+        return (
+            tokens.at[rows].set(adm_tok, mode="drop"),
+            cur_pos.at[rows].set(adm_lens, mode="drop"),
+        )
 
     @staticmethod
     def _decode_many_impl(
@@ -261,6 +278,56 @@ class DecodeEngine:
             head_dim=self.cfg.head_dim,
             dtype=self._cache_dtype,
         )
+
+    # -- canonical state shardings ------------------------------------------
+    #
+    # jit-produced arrays carry GSPMD-inferred shardings whose PartitionSpec
+    # representation is not a stable normal form: feeding one executable's
+    # output to another can key a fresh compile even though the layout is
+    # identical (round 3 worked around this by prewarming every executable
+    # TWICE to cover the 2-cycle of representations). The scheduler instead
+    # re-wraps every state array it carries across steps with the engine's
+    # canonical shardings — ``jax.device_put`` to an equivalent sharding is
+    # a metadata rewrap, not a copy — so each executable has exactly ONE
+    # steady-state input signature and prewarm compiles it exactly once
+    # (asserted by tests/test_serve.py::test_prewarm_covers_all_shapes).
+
+    def _canon_cache_shardings(self, batch: int):
+        from jax.sharding import NamedSharding
+
+        from llmss_tpu.engine.cache import cache_specs
+        from llmss_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
+
+        specs = cache_specs(
+            self.cfg.n_kv_heads,
+            self.mesh.shape[AXIS_TP],
+            batch_dp=batch % self.mesh.shape[AXIS_DP] == 0,
+            seq_sp=(
+                self.mesh.shape[AXIS_SP] > 1
+                and self.max_seq_len % self.mesh.shape[AXIS_SP] == 0
+            ),
+            quantized=jnp.dtype(self._cache_dtype) == jnp.int8,
+        )
+        return KVCache(*[
+            NamedSharding(self.mesh, s) if s is not None else None
+            for s in specs
+        ])
+
+    def canon_cache(self, cache: KVCache) -> KVCache:
+        """Re-wrap a (possibly jit-produced) cache with the same canonical
+        shardings ``new_cache`` uses — layout-identical, so no data moves."""
+        sh = self._canon_cache_shardings(cache.k.shape[1])
+        return KVCache(*[
+            jax.device_put(x, s) if x is not None else None
+            for x, s in zip(cache, sh)
+        ])
+
+    def canon_vec(self, x: jax.Array) -> jax.Array:
+        """Canonical (replicated) sharding for small per-row state vectors
+        (tokens, positions) carried across scheduler steps."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(x, NamedSharding(self.mesh, PartitionSpec()))
 
     def _sample_args(self, gens: "GenerationParams | list[GenerationParams]",
                      batch: int):

@@ -1,19 +1,36 @@
-"""Continuous batching: iteration-level request scheduling.
+"""Continuous batching: iteration-level request scheduling, pipelined.
 
 The reference serves one request at a time end-to-end
 (``consumer_server.py:73`` ``batch_size = 1``, with a TODO admitting batching
 is future work). This scheduler implements Orca-style continuous batching on
 top of the static-shape engine: a persistent ``[L, B, T]`` ring cache whose
-**rows** are the scheduling unit. New requests are prefilled into a batch-1
-scratch cache and inserted into a free row between decode steps; every decode
-step advances all active rows with per-row sampling parameters; finished rows
-free immediately for the next waiting request — no request waits for an
-unrelated request to finish.
+**rows** are the scheduling unit. New requests are prefilled into a scratch
+cache and inserted into free rows between decode chunks; every chunk advances
+all active rows with per-row sampling parameters; finished rows free for the
+next waiting request — no request waits for an unrelated request to finish.
+
+**The decode state lives on device and the host observes it one chunk late.**
+Round 3 fetched every chunk's tokens before dispatching the next chunk, so
+each chunk paid a full device→host round-trip on the critical path (~90 ms on
+the axon bench host — the serving layer reached 0.21 of roofline while the
+bare engine hit 0.65). Here:
+
+- ``tokens``/``cur_pos`` are device arrays; the fused decode chunk feeds
+  itself, so chunk N+1 is dispatched *before* chunk N's tokens are fetched
+  and the fetch overlaps device compute instead of serializing behind it.
+- Admissions merge their first tokens into the device state with a jitted
+  scatter (``DecodeEngine._admit_merge``) — the host never needs to see a
+  token to keep the device advancing.
+- The host processes chunk N's results (stream callbacks, EOS/max-token
+  finishes, row frees) while chunk N+1 runs. Freeing and admission therefore
+  lag one chunk — a freshly finished row keeps decoding discarded fills for
+  one extra chunk, the same cost an idle row pays anyway.
 
 Invariant tested in ``tests/test_continuous.py``: interleaved admission must
 produce exactly the tokens the request would get alone (row isolation — the
 causal mask is driven by per-row cache positions, so rows never see each
-other).
+other; the one-chunk lag changes *when* the host learns tokens, never which
+tokens the device computes).
 """
 
 from __future__ import annotations
@@ -38,7 +55,6 @@ class _Row:
     req_id: str
     gen: GenerationParams
     out: list[int]
-    cur_pos: int
     # Called as done_cb(tokens) on completion, done_cb(tokens, True) when
     # the request was cancelled (tokens = what was produced before the
     # cancel) — so the serving layer can answer honestly instead of
@@ -49,42 +65,75 @@ class _Row:
     # the decode chunk).
     stream_cb: Callable[[list[int]], None] | None = None
     emitted: int = 0
+    # Row is active on device (its admission merge is dispatched) but the
+    # host hasn't yet fetched its prefill-sampled first token.
+    awaiting_first: bool = True
+    t_submit: float = 0.0
 
 
 @dataclasses.dataclass
 class _InFlightAdmission:
-    """An admission batch whose prefill + insert are dispatched but whose
-    first tokens have not been fetched: resolved (rows activated) at the
-    top of the next step, overlapping admission with the decode chunk."""
+    """An admission whose prefill + insert + device-state merge are
+    dispatched but whose first tokens have not been fetched. Rows are
+    already active (the device decodes them from the next chunk on);
+    ``resolve`` is host bookkeeping only."""
 
-    taken: list  # [(req_id, ids, gen, cb, stream_cb, t_submit)]
-    rows: list[int]
+    entries: list  # [(row_idx, _Row)]
     tok: jax.Array  # [P] first sampled token per admission row (device)
+
+
+@dataclasses.dataclass
+class _InFlightChunk:
+    """A dispatched decode chunk whose tokens the host hasn't read yet."""
+
+    toks: jax.Array  # [rows, k] (device; copy_to_host_async issued)
+    k: int
+    # An admission was dispatched after this chunk: its device work runs
+    # before the next chunk, so the next fetch-to-fetch interval is not a
+    # clean decode-only sample.
+    has_admission: bool = False
 
 
 class ContinuousBatcher:
     def __init__(
-        self, engine: DecodeEngine, *, rows: int = 8, chunk_steps: int = 1
+        self, engine: DecodeEngine, *, rows: int = 8, chunk_steps: int = 1,
+        chunk_steps_low: int | None = None,
     ):
-        # chunk_steps > 1 advances all rows that many tokens per host
-        # round-trip (one fused scan + one fetch instead of per-token
-        # sync) — the serving throughput lever; admission/finish/cancel
-        # granularity becomes the chunk instead of the single token.
+        # chunk_steps > 1 advances all rows that many tokens per scheduler
+        # step (one fused scan instead of per-token dispatch); combined
+        # with the one-chunk-lag pipeline the host round-trip disappears
+        # from the critical path entirely.
+        #
+        # The chunk is also the scheduling granularity: admission and
+        # row-freeing happen once per chunk, so TTFT carries ~1.5 chunks
+        # of latency. ``chunk_steps_low`` (default: half of chunk_steps)
+        # is used while under 3/4 of the rows are busy — at low load the
+        # chip has headroom and the shorter chunk halves perceived TTFT;
+        # at saturation the full chunk keeps the host off the critical
+        # path. Both sizes are prewarmed.
         if chunk_steps < 1:
             raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
         self.engine = engine
         self.rows = rows
         self.chunk_steps = chunk_steps
+        self.chunk_steps_low = (
+            chunk_steps_low if chunk_steps_low is not None
+            else max(1, chunk_steps // 2)
+        )
         self.cache = engine.new_cache(rows)
-        self._scratch_template = None
         self.pending: deque = deque()
         self.active: dict[int, _Row] = {}
         self._free = list(range(rows))
-        self._tokens = np.zeros(rows, np.int32)
+        # Device-resident decode state (see module docstring), carried in
+        # the engine's canonical shardings so every executable keeps one
+        # steady-state signature (DecodeEngine.canon_cache/canon_vec).
+        self._tokens_dev = engine.canon_vec(jnp.zeros(rows, jnp.int32))
+        self._cur_pos_dev = engine.canon_vec(jnp.zeros(rows, jnp.int32))
         self._step_count = 0
         self._cancelled: set[str] = set()
-        self._inflight: _InFlightAdmission | None = None
-        self._cancel_at_resolve: set[str] = set()
+        self._inflight: _InFlightChunk | None = None
+        self._pending_adm: _InFlightAdmission | None = None
+        self._last_fetch_t: float | None = None
         self._lock = threading.Lock()
 
         cfg = engine.cfg
@@ -128,11 +177,11 @@ class ContinuousBatcher:
     def prewarm(self, seq_buckets: list[int] | None = None) -> int:
         """Compile every executable the scheduler can hit: admission
         prefill for each (admission-batch P, seq bucket S) pair, the row
-        insert per P, and the decode step/chunk at the full row count —
-        so no request ever eats a multi-second XLA compile mid-serve.
-        ``seq_buckets`` narrows the prompt-length envelope when known
-        (default: every bucket up to the engine's max_seq_len). Returns
-        the number of executables compiled."""
+        insert + device-state merge per P, and the decode chunk at the
+        full row count — so no request ever eats a multi-second XLA
+        compile mid-serve. ``seq_buckets`` narrows the prompt-length
+        envelope when known (default: every bucket up to the engine's
+        max_seq_len). Returns the number of executables compiled."""
         eng = self.engine
         if seq_buckets is None:
             seq_buckets = eng.seq_buckets()
@@ -145,41 +194,45 @@ class ContinuousBatcher:
         for P in sorted(set(Ps)):
             sa = eng._sample_args(GenerationParams(), P)
             scratch = None
+            tok = None
             for S in seq_buckets:
                 scratch = eng.new_cache(P)
                 ids = jnp.zeros((P, S), np.int32)
                 lens = jnp.ones(P, np.int32)
-                _tok, _, scratch = self._prefill_row(
+                tok, _, scratch = self._prefill_row(
                     eng.params, ids, scratch, jnp.asarray(lens), sa,
                 )
                 n_compiled += 1
             # Insert with all-dropped indices: compiles the P-shaped
-            # scatter without touching live rows. Twice, because the
-            # cache's PartitionSpec representation alternates between two
-            # normalized forms as it cycles through jit outputs — each
-            # cache-consuming executable has two steady-state signatures.
-            for _ in range(2):
-                self.cache = self._insert(
-                    self.cache, scratch,
+            # scatter without touching live rows. Once — the live path
+            # feeds it exactly these canonical shardings.
+            scratch = eng.canon_cache(scratch)
+            self.cache = eng.canon_cache(self._insert(
+                self.cache, scratch,
+                jnp.asarray(self._pad_row_idx(P, [])),
+            ))
+            n_compiled += 1
+            self._tokens_dev, self._cur_pos_dev = (
+                eng.canon_vec(x) for x in eng._admit_merge(
+                    self._tokens_dev, self._cur_pos_dev, eng.canon_vec(tok),
+                    jnp.ones(P, jnp.int32),
                     jnp.asarray(self._pad_row_idx(P, [])),
                 )
-                n_compiled += 1
-        # Decode step/chunk at the full row count (twice — see above).
+            )
+            n_compiled += 1
+        # Decode chunk at the full row count, both chunk sizes.
         sa = eng._sample_args(GenerationParams(), self.rows)
-        cur = jnp.zeros(self.rows, np.int32)
-        toks = jnp.zeros(self.rows, np.int32)
-        for _ in range(2):
-            if self.chunk_steps > 1:
-                _t, self.cache, _, _ = eng._decode_many(
-                    eng.params, toks, self.cache, cur, sa,
-                    jnp.ones(self.rows, bool),
-                    jnp.full(self.rows, -1, np.int32),
-                    n_steps=self.chunk_steps,
-                )
-            else:
-                _t, _, self.cache = eng._decode(
-                    eng.params, toks, self.cache, cur, sa
-                )
+        for k in sorted({self.chunk_steps, self.chunk_steps_low}):
+            toks, cache, cur_pos, _ = eng._decode_many(
+                eng.params, self._tokens_dev, self.cache,
+                self._cur_pos_dev, sa,
+                jnp.ones(self.rows, bool),
+                jnp.full(self.rows, -1, np.int32),
+                n_steps=k,
+            )
+            self.cache = eng.canon_cache(cache)
+            self._cur_pos_dev = eng.canon_vec(cur_pos)
+            self._tokens_dev = eng.canon_vec(toks[:, -1])
             n_compiled += 1
         # The prewarm decode ran with every row marked done/free, but its
         # cache writes still landed — reset positions so no ghost slots
@@ -192,6 +245,8 @@ class ContinuousBatcher:
                 self.cache.positions.sharding,
             ),
         )
+        self._cur_pos_dev = eng.canon_vec(jnp.zeros(self.rows, jnp.int32))
+        self._tokens_dev = eng.canon_vec(jnp.zeros(self.rows, jnp.int32))
         return n_compiled
 
     # -- submission ---------------------------------------------------------
@@ -215,18 +270,16 @@ class ContinuousBatcher:
 
     def _admit_dispatch(self) -> _InFlightAdmission | None:
         """Dispatch admission for every pending request that has a free
-        row: ONE batched prefill + ONE row-scatter insert, **no blocking
-        fetch** — the first tokens are read by ``_resolve_admission`` at
-        the top of the next step, so admission compute and its device→host
-        round-trip overlap the decode chunk instead of serializing behind
-        it (per-request admission measured ~0.2 s over the bench host's
-        tunnel; batched + overlapped it disappears from the critical path).
+        row: ONE batched prefill + ONE row-scatter cache insert + ONE
+        device-state merge, **no blocking fetch**. The rows become active
+        immediately (the next decode chunk reads the merged device state);
+        the host fetches the first tokens later, overlapped with that
+        chunk (``_resolve_admission``).
 
-        Must be called *after* the step's decode is dispatched: device
-        programs run in dispatch order, so the insert lands between this
-        chunk and the next — the chunk can't scribble on freshly inserted
-        rows (done rows still write their cache slot), and the next chunk
-        sees them.
+        Must be called *after* the step's decode chunk is dispatched:
+        device programs run in dispatch order, so the insert + merge land
+        between this chunk and the next — the running chunk can't scribble
+        on freshly inserted rows, and the next chunk sees them.
 
         The admission batch pads to a power of two (dummy rows) so the
         compile envelope stays (log₂ rows × log₂ seq buckets) executables.
@@ -261,56 +314,67 @@ class ContinuousBatcher:
             self.engine.params, jnp.asarray(padded), scratch,
             jnp.asarray(lens), sample_args,
         )
-        self.cache = self._insert(
+        scratch = self.engine.canon_cache(scratch)
+        self.cache = self.engine.canon_cache(self._insert(
             self.cache, scratch, jnp.asarray(row_idx)
+        ))
+        self._tokens_dev, self._cur_pos_dev = (
+            self.engine.canon_vec(x) for x in self.engine._admit_merge(
+                self._tokens_dev, self._cur_pos_dev,
+                self.engine.canon_vec(tok),
+                jnp.asarray(lens), jnp.asarray(row_idx),
+            )
         )
-        return _InFlightAdmission(taken=taken, rows=rows, tok=tok)
+        try:
+            tok.copy_to_host_async()
+        except AttributeError:  # older jax array types
+            pass
 
-    def _resolve_admission(self) -> int:
-        """Activate the previously dispatched admission batch (fetch its
-        first tokens — by now overlapped with the last decode chunk)."""
-        adm, self._inflight = self._inflight, None
+        entries = []
+        for i, (req_id, ids, gen, cb, scb, t_submit) in enumerate(taken):
+            r = _Row(
+                req_id=req_id, gen=gen, out=[], done_cb=cb, stream_cb=scb,
+                awaiting_first=True, t_submit=t_submit,
+            )
+            self.active[rows[i]] = r
+            entries.append((rows[i], r))
+        return _InFlightAdmission(entries=entries, tok=tok)
+
+    def _resolve_admission(self, adm: _InFlightAdmission | None) -> int:
+        """Host bookkeeping for a dispatched admission (fetch its first
+        tokens — by now overlapped with at least one decode chunk)."""
         if adm is None:
             return 0
         firsts = np.asarray(adm.tok)
         now = time.perf_counter()
-        cancelled = self._cancel_at_resolve
-        self._cancel_at_resolve = set()
-        for i, (req_id, ids, gen, cb, scb, t_submit) in enumerate(adm.taken):
-            row = adm.rows[i]
-            r = _Row(
-                req_id=req_id, gen=gen, out=[], cur_pos=len(ids),
-                done_cb=cb, stream_cb=scb,
-            )
-            if req_id in cancelled:
-                # Not served, no TTFT sample — matches the static Worker's
-                # accounting for pre-cancelled requests.
-                self.engine.metrics.add_cancelled(1)
-                self._finish(row, r, cancelled=True)
-                continue
+        n = 0
+        for i, (row, r) in enumerate(adm.entries):
+            if self.active.get(row) is not r:
+                continue  # cancelled (and possibly re-admitted) meanwhile
             # TTFT spans submit → resolve: queueing for a free row, the
             # admission prefill, AND the decode chunk the admission
             # deliberately overlapped — the time a client actually waited
-            # for its first token. NOT recorded as prefill latency (that
-            # stat stays a tight measure of prefill compute).
-            self.engine.metrics.ttft.record(now - t_submit)
+            # for its first token.
+            self.engine.metrics.ttft.record(now - r.t_submit)
             self.engine.metrics.add_request(1)
+            r.awaiting_first = False
+            n += 1
             first = int(firsts[i])
-            eos = gen.eos_token_id if gen.eos_token_id is not None else -1
-            if first == eos or gen.max_new_tokens == 0:
+            eos = (
+                r.gen.eos_token_id if r.gen.eos_token_id is not None else -1
+            )
+            if first == eos or r.gen.max_new_tokens == 0:
                 self._finish(row, r)
                 continue
             r.out.append(first)
             self.engine.metrics.add_tokens(1)
-            self._tokens[row] = first
-            self.active[row] = r
             if len(r.out) >= r.gen.max_new_tokens:
                 self._finish(row, r)
             else:
                 # First token goes out now, not a full chunk later —
                 # streaming's perceived TTFT is the point.
                 self._flush_stream(r)
-        return len(adm.taken)
+        return n
 
     def _finish(self, row: int, r: _Row, cancelled: bool = False) -> None:
         self.active.pop(row, None)
@@ -339,8 +403,9 @@ class ContinuousBatcher:
     def _process_cancellations(self) -> int:
         """Worker-thread half of ``cancel``: drop marked pending requests
         (their callbacks fire with ``cancelled=True`` so every submitted
-        request gets exactly one response), free marked active rows, and
-        mark in-flight admissions for drop at resolve. Unmatched ids are
+        request gets exactly one response) and free marked active rows
+        (admitted-but-unresolved rows are active too — their resolve
+        notices the row changed hands and skips). Unmatched ids are
         discarded — the broker-side cancellation flag persists (TTL'd), so
         a cancel racing ahead of its request is re-delivered by the
         worker's ``check_cancelled`` once the request shows up."""
@@ -353,11 +418,6 @@ class ContinuousBatcher:
         n = len(dropped)
         for _rid, _ids, _gen, cb, _scb, _t in dropped:
             cb([], True)
-        if self._inflight is not None:
-            for req_id, *_rest in self._inflight.taken:
-                if req_id in ids:
-                    # metrics counted at resolve, where the row frees
-                    self._cancel_at_resolve.add(req_id)
         for row, r in list(self.active.items()):
             if r.req_id in ids:
                 self._finish(row, r, cancelled=True)
@@ -367,20 +427,18 @@ class ContinuousBatcher:
         return n
 
     def live_ids(self) -> list[str]:
-        """Every request id this batcher currently owns (pending, in-flight
-        admission, active) — what the worker polls cancellation flags for."""
+        """Every request id this batcher currently owns (pending or
+        active, including admitted-but-unresolved rows) — what the worker
+        polls cancellation flags for."""
         with self._lock:
             ids = [req_id for (req_id, *_r) in self.pending]
-        if self._inflight is not None:
-            ids += [req_id for (req_id, *_r) in self._inflight.taken]
         ids += [r.req_id for r in self.active.values()]
         return ids
 
     def drain_all(self) -> list[str]:
-        """Remove every pending, in-flight, and active request and return
-        their ids — supervisor teardown: a restarting worker must error
-        these out so no client waits forever on a request the new batcher
-        never saw.
+        """Remove every pending and active request and return their ids —
+        supervisor teardown: a restarting worker must error these out so no
+        client waits forever on a request the new batcher never saw.
 
         Runs on the worker thread (the supervisor tears down from inside the
         crashed worker's loop), so touching ``self.active`` here doesn't race
@@ -389,11 +447,9 @@ class ContinuousBatcher:
         with self._lock:
             ids = [req_id for (req_id, *_rest) in self.pending]
             self.pending.clear()
-        if self._inflight is not None:
-            adm, self._inflight = self._inflight, None
-            ids += [req_id for (req_id, *_rest) in adm.taken]
-            with self._lock:
-                self._free.extend(adm.rows)
+        self._inflight = None
+        self._pending_adm = None
+        self._last_fetch_t = None
         for row in list(self.active):
             r = self.active.pop(row)
             ids.append(r.req_id)
@@ -401,79 +457,50 @@ class ContinuousBatcher:
                 self._free.append(row)
         return ids
 
-    def _sample_args_all(self):
+    def _chunk_args(self):
+        """Per-chunk host-side control arrays. ``done``/``eos``/sampling
+        params come from the host's (one-chunk-lagged) view — a row that
+        finished on device but not yet on host rides one extra chunk as a
+        done row emitting discarded fills, the same cost an idle row pays.
+        """
+        done = np.ones(self.rows, bool)
+        eos_arr = np.full(self.rows, -1, np.int32)
         gens = []
         for i in range(self.rows):
             r = self.active.get(i)
             gens.append(r.gen if r else GenerationParams())
-        return self.engine._sample_args(gens, self.rows)
+            if r is not None:
+                done[i] = False
+                if r.gen.eos_token_id is not None:
+                    eos_arr[i] = r.gen.eos_token_id
+        sa = self.engine._sample_args(gens, self.rows)
+        return done, eos_arr, sa
 
-    def step(self) -> int:
-        """One scheduler iteration: resolve last step's admissions, advance
-        all active rows ``chunk_steps`` tokens in one fused scan, and
-        dispatch new admissions to overlap with that scan.
-
-        Rows keep their exact solo tokens (row isolation is positional, and
-        a row that finishes mid-chunk is freed with only its real tokens) —
-        the chunk only batches the host round-trips. Free/finished rows ride
-        along as done rows emitting discarded fills, the same cost a
-        single-step loop pays for inactive rows in the batch.
-        """
-        self._process_cancellations()
-        self._resolve_admission()
-        if not self.active:
-            # Nothing to overlap with: dispatch + resolve immediately.
-            self._inflight = self._admit_dispatch()
-            if self._inflight is not None:
-                self._resolve_admission()
-            if not self.active:
-                return 0
-
-        k = self.chunk_steps
-        cur_pos = np.zeros(self.rows, np.int32)
-        done = np.ones(self.rows, bool)
-        eos_arr = np.full(self.rows, -1, np.int32)
-        for i, r in self.active.items():
-            cur_pos[i] = r.cur_pos
-            done[i] = False
-            if r.gen.eos_token_id is not None:
-                eos_arr[i] = r.gen.eos_token_id
-
-        t0 = time.perf_counter()
-        if k > 1:
-            toks, self.cache, _, _ = self.engine._decode_many(
-                self.engine.params, jnp.asarray(self._tokens), self.cache,
-                jnp.asarray(cur_pos), self._sample_args_all(),
-                jnp.asarray(done), jnp.asarray(eos_arr), n_steps=k,
+    def _process_chunk(self, chunk: _InFlightChunk) -> int:
+        """Fetch a chunk's tokens (overlapped with the next chunk already
+        running on device) and apply host bookkeeping: per-row token
+        accounting, stream flushes, EOS / max-token finishes."""
+        toks_np = np.asarray(chunk.toks)  # [rows, k] — the blocking fetch
+        now = time.perf_counter()
+        if self._last_fetch_t is not None and not chunk.has_admission:
+            # Fetch-to-fetch interval — but only for chunks with no
+            # admission dispatched in between: the admission's prefill +
+            # insert + merge execute on device between the two chunks and
+            # would inflate the per-token decode stat.
+            self.engine.metrics.decode_step.record(
+                (now - self._last_fetch_t) / chunk.k
             )
-        else:
-            tok, _, self.cache = self.engine._decode(
-                self.engine.params, jnp.asarray(self._tokens), self.cache,
-                jnp.asarray(cur_pos), self._sample_args_all(),
-            )
-            toks = tok[:, None]
-        # Admission prefill+insert dispatched while the chunk runs; device
-        # order guarantees the insert lands between this chunk and the
-        # next. Resolved (rows activated) at the top of the next step.
-        t_adm = time.perf_counter()
-        self._inflight = self._admit_dispatch()
-        t_adm = time.perf_counter() - t_adm
-        toks_np = np.asarray(toks)  # [rows, k] — the one blocking sync
-        # Admission prep (host-side padding + dispatches) overlaps the
-        # chunk on device but not on the host clock — subtract it so the
-        # decode_step stat stays a clean per-token latency.
-        self.engine.metrics.decode_step.record(
-            (time.perf_counter() - t0 - t_adm) / k
-        )
+        self._last_fetch_t = now
 
         n = 0
         for i in list(self.active):
             r = self.active[i]
+            if r.awaiting_first:
+                continue  # admitted after this chunk was dispatched
             eos = r.gen.eos_token_id if r.gen.eos_token_id is not None else -1
             finished = False
-            for col in range(k):
+            for col in range(chunk.k):
                 t = int(toks_np[i, col])
-                r.cur_pos += 1
                 if t == eos:
                     finished = True
                     break
@@ -485,11 +512,77 @@ class ContinuousBatcher:
             if finished:
                 self._finish(i, r)
             else:
-                # Survived the whole chunk: device advanced it k steps.
-                self._tokens[i] = int(toks_np[i, k - 1])
                 self._flush_stream(r)
-        self._step_count += 1
         self.engine.metrics.add_tokens(n)
+        return n
+
+    def step(self) -> int:
+        """One scheduler iteration of the pipelined loop:
+
+        1. dispatch decode chunk N+1 from the device-resident state — the
+           device never waits for the host;
+        2. fetch + process chunk N's tokens, overlapped with chunk N+1
+           executing on device — this is where rows finish and free;
+        3. resolve the admission dispatched last step (host bookkeeping —
+           its merge already executed on device);
+        4. dispatch admissions for the rows phase 2 just freed; their
+           prefill + insert + merge land between chunk N+1 and N+2, so a
+           finished row is back in service after exactly one idle chunk.
+
+        Rows keep their exact solo tokens (row isolation is positional,
+        and the device state never depends on host processing) — the
+        pipeline only delays when the *host* learns them by one chunk.
+        """
+        self._process_cancellations()
+
+        if not self.active:
+            # Nothing running: drain the pipeline, then admit directly
+            # (resolve immediately — nothing to overlap with; the merge
+            # makes rows live for the next step's first chunk).
+            if self._inflight is not None:
+                chunk, self._inflight = self._inflight, None
+                self._last_fetch_t = None
+                n = self._process_chunk(chunk)
+                n += self._resolve_admission(self._pending_adm)
+                self._pending_adm = None
+                return n
+            if self._pending_adm is not None:
+                adm, self._pending_adm = self._pending_adm, None
+                return self._resolve_admission(adm)
+            adm = self._admit_dispatch()
+            if adm is None:
+                return 0
+            self._last_fetch_t = None
+            return self._resolve_admission(adm)
+
+        done, eos_arr, sa = self._chunk_args()
+        busy = len(self.active) >= (3 * self.rows) // 4
+        k = self.chunk_steps if busy else self.chunk_steps_low
+        toks, cache, cur_pos, _ = self.engine._decode_many(
+            self.engine.params, self._tokens_dev, self.cache,
+            self._cur_pos_dev, sa, jnp.asarray(done), jnp.asarray(eos_arr),
+            n_steps=k,
+        )
+        self.cache = self.engine.canon_cache(cache)
+        self._cur_pos_dev = self.engine.canon_vec(cur_pos)
+        self._tokens_dev = self.engine.canon_vec(toks[:, -1])
+        try:
+            toks.copy_to_host_async()
+        except AttributeError:
+            pass
+        chunk = _InFlightChunk(toks=toks, k=k)
+
+        prev, self._inflight = self._inflight, chunk
+        n = 0
+        if prev is not None:
+            n = self._process_chunk(prev)  # frees finished rows
+        n += self._resolve_admission(self._pending_adm)
+        # Admission takes the rows processing just freed; its device work
+        # overlaps the in-flight chunk and lands before the next one.
+        self._pending_adm = self._admit_dispatch()
+        if self._pending_adm is not None and self._inflight is not None:
+            self._inflight.has_admission = True
+        self._step_count += 1
         return n
 
     @property
@@ -497,7 +590,7 @@ class ContinuousBatcher:
         with self._lock:
             return (
                 not self.active and not self.pending
-                and self._inflight is None
+                and self._inflight is None and self._pending_adm is None
             )
 
     def run_until_idle(self) -> None:

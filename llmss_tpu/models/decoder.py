@@ -35,6 +35,7 @@ from llmss_tpu.engine.cache import (
 )
 from llmss_tpu.models.common import DecoderConfig, act_fn
 from llmss_tpu.ops.attention import (
+    decode_mask_penalty,
     dispatch_attention,
     fresh_kv_decode_attention,
     make_causal_mask,
@@ -42,7 +43,7 @@ from llmss_tpu.ops.attention import (
 from llmss_tpu.ops.layers import (
     LinearParams, NormParams, dense, dense_t, embedding,
 )
-from llmss_tpu.ops.rope import apply_rope
+from llmss_tpu.ops.rope import apply_rope, sin_cos_tables
 from llmss_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
 from llmss_tpu.parallel.sharding import constrain
 
@@ -496,14 +497,9 @@ def forward(
     # attention contractions (+0.67 ms/step measured at bench scale).
     sin_cos = None
     if cfg.positions == "rotary":
-        from llmss_tpu.ops.rope import _sin_cos
-
-        sin_cos = _sin_cos(
+        sin_cos = sin_cos_tables(
             positions, cfg.rotary_dim or cfg.head_dim, cfg.rope_theta
         )
-    # The decode mask is position-only — hoisted out of the layer scan for
-    # the same fusion reason (ops/attention.py: decode_mask_penalty).
-    from llmss_tpu.ops.attention import decode_mask_penalty
     # Single-token decode defers all KV writes to one batched scatter after
     # the layer scan (TPU scatter cost is per-op; L in-scan scatters were
     # ~25% of decode step time) — on sp>1 meshes too, via the fresh-KV LSE

@@ -23,8 +23,10 @@ import jax
 import jax.numpy as jnp
 
 
-def _sin_cos(positions: jax.Array, dim: int, theta: float):
-    """sin/cos [B, S, dim/2] in fp32 for integer positions."""
+def sin_cos_tables(positions: jax.Array, dim: int, theta: float):
+    """sin/cos [B, S, dim/2] in fp32 for integer positions — the tables
+    ``apply_rope`` consumes. Public so the decode scan can compute them
+    once per step and pass them to every layer (models/decoder.py)."""
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim)
     )
@@ -43,7 +45,7 @@ def apply_rope(
 ) -> jax.Array:
     """Rotate the first ``rotary_dim`` features of each head by position.
 
-    ``sin_cos`` optionally supplies precomputed ``_sin_cos(positions,
+    ``sin_cos`` optionally supplies precomputed ``sin_cos_tables(positions,
     rotary_dim, theta)``. The decode scan hoists this: sin/cos depend only
     on positions (layer-invariant), and computing them *inside* the layer
     body makes q-rope and k-rope share subexpressions in a way that breaks
@@ -53,7 +55,7 @@ def apply_rope(
     D = x.shape[-1]
     rotary_dim = rotary_dim or D
     rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
-    sin, cos = sin_cos if sin_cos is not None else _sin_cos(
+    sin, cos = sin_cos if sin_cos is not None else sin_cos_tables(
         positions, rotary_dim, theta
     )
     sin = sin[:, :, None, :]  # broadcast over heads
