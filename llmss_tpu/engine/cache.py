@@ -33,9 +33,10 @@ class KVCache(NamedTuple):
     positions: jax.Array  # [B, T] int32, -1 = empty slot
     # Per-(layer, row, slot, head) dequant scales, set iff k/v are int8
     # (kv_dtype="int8"): value = int8 * scale. Halves cache HBM footprint;
-    # the dequant multiply fuses into the layer-slice copy the decode scan
-    # already materializes, so step traffic *drops* (int8 read replaces a
-    # bf16 read on the copy's input side).
+    # on the decode hot path the scales FOLD into the attention
+    # contractions (they factor out of both the d- and t-sums,
+    # ops/attention.py), so the dots stream raw int8 and step traffic
+    # *drops* — measured faster than the bf16 cache at bench scale.
     k_scale: jax.Array | None = None  # [L, B, T, Hkv] f32
     v_scale: jax.Array | None = None
 
@@ -88,6 +89,23 @@ def cache_specs(
     )
 
 
+def cache_specs_for(
+    mesh: Mesh, *, batch: int, max_len: int, n_kv_heads: int, dtype,
+) -> KVCache:
+    """The spec-selection policy (dp only when the batch divides, sp only
+    when the length divides) applied to a concrete mesh + shape. The ONE
+    place this policy lives: ``init_cache`` creates caches with it and
+    ``DecodeEngine.canon_cache`` re-wraps carried caches with it — they
+    must agree exactly or the rewrap becomes a real resharding."""
+    return cache_specs(
+        n_kv_heads,
+        mesh.shape[AXIS_TP],
+        batch_dp=batch % mesh.shape[AXIS_DP] == 0,
+        seq_sp=mesh.shape[AXIS_SP] > 1 and max_len % mesh.shape[AXIS_SP] == 0,
+        quantized=jnp.dtype(dtype) == jnp.int8,
+    )
+
+
 def init_cache(
     mesh: Mesh,
     *,
@@ -99,12 +117,9 @@ def init_cache(
     dtype=jnp.bfloat16,
 ) -> KVCache:
     quantized = jnp.dtype(dtype) == jnp.int8
-    specs = cache_specs(
-        n_kv_heads,
-        mesh.shape[AXIS_TP],
-        batch_dp=batch % mesh.shape[AXIS_DP] == 0,
-        seq_sp=mesh.shape[AXIS_SP] > 1 and max_len % mesh.shape[AXIS_SP] == 0,
-        quantized=quantized,
+    specs = cache_specs_for(
+        mesh, batch=batch, max_len=max_len, n_kv_heads=n_kv_heads,
+        dtype=dtype,
     )
     shape = (n_layers, batch, max_len, n_kv_heads, head_dim)
 

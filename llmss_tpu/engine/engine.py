@@ -94,17 +94,11 @@ class DecodeEngine:
         self.max_seq_len = max_seq_len or cfg.max_position_embeddings
         # kv_dtype="int8" stores the cache quantized (per-token-per-head
         # scales): half the HBM footprint → double the rows/context per
-        # chip, and the dequant rides the decode scan's existing layer
-        # copy. Values-only quality cost (see tests/test_int8_cache.py).
+        # chip. On sp=1 meshes the dequant scales fold into the attention
+        # contractions (no dequantized copy materializes,
+        # ops/attention.py); sp>1 meshes pre-dequantize each layer before
+        # the shard_map'd sequence-parallel attention (models/decoder.py).
         if kv_dtype == "int8":
-            from llmss_tpu.parallel.mesh import AXIS_SP
-
-            if mesh is not None and mesh.shape[AXIS_SP] > 1:
-                raise ValueError(
-                    "kv_dtype='int8' does not support sp>1 meshes yet "
-                    "(the sequence-parallel attention paths read the "
-                    "cache raw)"
-                )
             self._cache_dtype = jnp.int8
         else:
             self._cache_dtype = cfg.compute_dtype
@@ -295,18 +289,11 @@ class DecodeEngine:
     def _canon_cache_shardings(self, batch: int):
         from jax.sharding import NamedSharding
 
-        from llmss_tpu.engine.cache import cache_specs
-        from llmss_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
+        from llmss_tpu.engine.cache import cache_specs_for
 
-        specs = cache_specs(
-            self.cfg.n_kv_heads,
-            self.mesh.shape[AXIS_TP],
-            batch_dp=batch % self.mesh.shape[AXIS_DP] == 0,
-            seq_sp=(
-                self.mesh.shape[AXIS_SP] > 1
-                and self.max_seq_len % self.mesh.shape[AXIS_SP] == 0
-            ),
-            quantized=jnp.dtype(self._cache_dtype) == jnp.int8,
+        specs = cache_specs_for(
+            self.mesh, batch=batch, max_len=self.max_seq_len,
+            n_kv_heads=self.cfg.n_kv_heads, dtype=self._cache_dtype,
         )
         return KVCache(*[
             NamedSharding(self.mesh, s) if s is not None else None

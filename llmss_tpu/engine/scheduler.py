@@ -88,9 +88,9 @@ class _InFlightChunk:
 
     toks: jax.Array  # [rows, k] (device; copy_to_host_async issued)
     k: int
-    # An admission was dispatched after this chunk: its device work runs
-    # before the next chunk, so the next fetch-to-fetch interval is not a
-    # clean decode-only sample.
+    # An admission's device work (prefill+insert+merge) ran between the
+    # previous chunk and this one, so this chunk's fetch-to-fetch interval
+    # is not a clean decode-only sample.
     has_admission: bool = False
 
 
@@ -570,7 +570,12 @@ class ContinuousBatcher:
             toks.copy_to_host_async()
         except AttributeError:
             pass
-        chunk = _InFlightChunk(toks=toks, k=k)
+        # The admission dispatched LAST step sits between the previous
+        # chunk and this one on the device queue, so this chunk's
+        # fetch-to-fetch interval includes its prefill+insert+merge time.
+        chunk = _InFlightChunk(
+            toks=toks, k=k, has_admission=self._pending_adm is not None
+        )
 
         prev, self._inflight = self._inflight, chunk
         n = 0
@@ -580,8 +585,6 @@ class ContinuousBatcher:
         # Admission takes the rows processing just freed; its device work
         # overlaps the in-flight chunk and lands before the next one.
         self._pending_adm = self._admit_dispatch()
-        if self._pending_adm is not None and self._inflight is not None:
-            self._inflight.has_admission = True
         self._step_count += 1
         return n
 

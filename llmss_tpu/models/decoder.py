@@ -244,6 +244,11 @@ def _block(
     ablate: str | None = None,  # profiling only (tools/profile_decode.py)
     sin_cos=None,  # precomputed rope tables, hoisted out of the layer scan
     penalty=None,  # precomputed decode mask penalty, hoisted likewise
+    # int8 cache: per-token-per-head dequant scales [B, T, Hkv]; when set,
+    # k_cache/v_cache are the RAW int8 slices and the scales fold into the
+    # attention contractions (ops/attention.py) — no dequant materializes.
+    k_scale=None,
+    v_scale=None,
 ):
     """One decoder block.
 
@@ -288,7 +293,7 @@ def _block(
             attn = fresh_kv_decode_attention(
                 q, k_cache, v_cache, k, v, positions, kv_positions, slots,
                 scale=cfg.attn_scale, window=cfg.sliding_window,
-                penalty=penalty,
+                penalty=penalty, k_scale=k_scale, v_scale=v_scale,
             )
     else:
         k_cache, v_cache = write_layer(k_cache, v_cache, k, v, slots)
@@ -313,7 +318,7 @@ def _block(
     h = constrain(h, P(AXIS_DP, seq_ax, None))
     if defer_write:
         return h, k, v  # fresh KV for the single post-scan scatter
-    return h, k_cache, v_cache
+    return h, k_cache, v_cache, k, v
 
 
 def _make_decode_kernel_attn(cfg, mesh, cache, positions, slots):
@@ -545,13 +550,21 @@ def forward(
                 )
 
             def body(h, xs):
+                ks_l = vs_l = None
                 if quant:
                     bp, k_q, v_q, ks_l, vs_l = xs
-                    # Dequant fuses into the layer-slice copy the scan
-                    # materializes anyway (engine/cache.py: int8 read in,
-                    # compute-dtype out).
-                    k_l = dequantize_kv(k_q, ks_l, dtype)
-                    v_l = dequantize_kv(v_q, vs_l, dtype)
+                    if sp_attn is not None:
+                        # The sp shard_map path expects compute-dtype
+                        # chunks: pre-dequantize (materializes a bf16 copy
+                        # of the layer — the price of int8 on sp meshes).
+                        k_l = dequantize_kv(k_q, ks_l, dtype)
+                        v_l = dequantize_kv(v_q, vs_l, dtype)
+                        ks_l = vs_l = None
+                    else:
+                        # Raw int8 slices; the scales fold into the
+                        # attention contractions (fresh_kv_decode_attention)
+                        # so no dequantized copy ever materializes.
+                        k_l, v_l = k_q, v_q
                 else:
                     bp, k_l, v_l = xs
                 h, k_f, v_f = _block(
@@ -559,6 +572,7 @@ def forward(
                     None, mesh=mesh, defer_write=True,
                     attn_override=sp_attn, ablate=_ablate,
                     sin_cos=sin_cos, penalty=penalty,
+                    k_scale=ks_l, v_scale=vs_l,
                 )
                 ys = None if _ablate == "no_scatter" else (k_f, v_f)
                 return h, ys
@@ -591,6 +605,8 @@ def forward(
         kv_valid = new_kv_positions >= 0
         mask = make_causal_mask(positions, new_kv_positions, kv_valid)
 
+        b_idx = jnp.arange(input_ids.shape[0], dtype=jnp.int32)[:, None]
+
         def body(h, xs):
             if quant:
                 bp, k_q, v_q, ks_l, vs_l = xs
@@ -598,20 +614,24 @@ def forward(
                 v_l = dequantize_kv(v_q, vs_l, dtype)
             else:
                 bp, k_l, v_l = xs
-            h, k_l, v_l = _block(
+            h, k_l, v_l, k_f, v_f = _block(
                 cfg, bp, h, positions, k_l, v_l, new_kv_positions, slots,
                 mask, mesh=mesh, sin_cos=sin_cos,
             )
             if quant:
-                # Re-quantize the written layer. NOTE: the dequant above runs
-                # in compute dtype (bf16, 8 mantissa bits), so a dequant→quant
-                # round trip can flip previously stored slots by ±1 — benign
-                # only because prefill always starts from an empty cache
-                # (every valid slot is freshly written this call). Any future
-                # S>1 forward over a populated int8 cache (prefix reuse) must
-                # dequantize in fp32 or skip requantizing untouched slots.
-                k_q, ks_l = quantize_kv(k_l)
-                v_q, vs_l = quantize_kv(v_l)
+                # Quantize ONLY the freshly written tokens and scatter them
+                # (values + scales) into the carried int8 cache. Untouched
+                # slots are never dequant→requant round-tripped, so they
+                # are bit-stable by construction — prefix reuse over a
+                # populated int8 cache stays exact. (The dequantized
+                # ``k_l``/``v_l`` above exist only for this layer's
+                # attention read.)
+                k8, ks_f = quantize_kv(k_f)  # [B, S, Hkv(, D)]
+                v8, vs_f = quantize_kv(v_f)
+                k_q = k_q.at[b_idx, slots].set(k8)
+                v_q = v_q.at[b_idx, slots].set(v8)
+                ks_l = ks_l.at[b_idx, slots].set(ks_f)
+                vs_l = vs_l.at[b_idx, slots].set(vs_f)
                 return h, (k_q, v_q, ks_l, vs_l)
             return h, (k_l, v_l)
 

@@ -148,6 +148,8 @@ def fresh_kv_decode_attention(
     scale: float | None = None,
     window: int | None = None,
     penalty: jax.Array | None = None,  # [B, T] f32 — precomputed mask
+    k_scale: jax.Array | None = None,  # [B, T, Hkv] f32 — int8 cache scales
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Decode attention over a stale cache + the fresh current-token KV,
     merged in one exact softmax.
@@ -166,6 +168,16 @@ def fresh_kv_decode_attention(
     boolean chain + ``where`` inside the per-layer score fusion measurably
     un-fuses the cache read (~0.6 ms/step at bench scale), while a single
     precomputed additive [B, T] operand keeps the fusion streaming.
+
+    ``k_scale``/``v_scale`` accept an int8 cache's per-token-per-head
+    dequant scales **instead of pre-dequantized caches**: the scales
+    factor out of both contractions (``Σ_d q·(k8·s_t) = s_t·Σ_d q·k8``
+    and ``Σ_t p_t·(v8·s_t) = Σ_t (p_t·s_t)·v8``), so the dots stream the
+    raw int8 bytes (dtype convert folds into the dot for free) and the
+    scales multiply the small score/probability tensors — no
+    materialized bf16 dequant copy of the cache (round 3 paid
+    ~1.8 ms/step for one at bench scale). fp32 score math is preserved;
+    folding is *more* precise than pre-dequantizing to compute dtype.
     """
     B, S, Hq, D = q.shape
     T, Hkv = k_cache.shape[1], k_cache.shape[2]
@@ -175,6 +187,9 @@ def fresh_kv_decode_attention(
 
     qf = q.astype(jnp.float32).reshape(B, S, Hkv, G, D) * scale
     s_c = jnp.einsum("bskgd,btkd->bkgst", qf, k_cache.astype(jnp.float32))
+    if k_scale is not None:
+        # [B, T, Hkv] -> [B, Hkv, 1, 1, T]
+        s_c = s_c * k_scale.transpose(0, 2, 1)[:, :, None, None, :]
     if penalty is None:
         penalty = decode_mask_penalty(q_pos, kv_pos_old, slots, window)
     # Additive masking: exact for the finite-min convention (adding the
@@ -191,6 +206,11 @@ def fresh_kv_decode_attention(
     p_c = jnp.exp(s_c - m)
     p_s = jnp.exp(s_s - m)
     denom = jnp.sum(p_c, axis=-1, keepdims=True) + p_s
+    # Fold the V dequant scales into the probabilities (see docstring) —
+    # the contraction below then reads raw int8.
+    p_v = p_c
+    if v_scale is not None:
+        p_v = p_c * v_scale.transpose(0, 2, 1)[:, :, None, None, :]
     if G == 1 and S == 1:
         # Value contraction as a hand-written broadcast-multiply + fp32
         # reduce over t — a MAJOR dim of the [B, T, Hkv, D] cache, so the
@@ -201,7 +221,7 @@ def fresh_kv_decode_attention(
         # copy the K-score dot needs (~0.3 ms/step at bench scale). The
         # K side stays a real MXU dot: its contraction is over the minor
         # d dim, where a VPU mult+reduce is a (slow) cross-lane pattern.
-        p_t = p_c[:, :, 0, 0, :]  # [B, Hkv, T]
+        p_t = p_v[:, :, 0, 0, :]  # [B, Hkv, T]
         vterm = jnp.sum(
             p_t.transpose(0, 2, 1)[..., None]
             * v_cache.astype(jnp.float32),
@@ -210,7 +230,7 @@ def fresh_kv_decode_attention(
         out_c = vterm[:, :, None, None, :]  # [B, Hkv, 1, 1, D]
     else:
         out_c = jnp.einsum(
-            "bkgst,btkd->bkgsd", p_c, v_cache.astype(jnp.float32)
+            "bkgst,btkd->bkgsd", p_v, v_cache.astype(jnp.float32)
         )
     out = (
         out_c
