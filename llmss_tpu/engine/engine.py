@@ -26,6 +26,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from functools import partial
+from typing import TYPE_CHECKING
 
 import jax
 import jax.numpy as jnp
@@ -33,8 +34,12 @@ import numpy as np
 
 from llmss_tpu.engine.cache import KVCache, init_cache
 from llmss_tpu.models.common import DecoderConfig
-from llmss_tpu.models.decoder import Params, forward
 from llmss_tpu.ops.sampling import sample
+
+if TYPE_CHECKING:  # a runtime import would be circular when the models
+    # package is imported first (models.decoder -> engine.cache runs
+    # engine/__init__ -> engine.engine -> models.decoder).
+    from llmss_tpu.models.decoder import Params  # noqa: F401
 
 
 @dataclasses.dataclass
@@ -125,6 +130,8 @@ class DecodeEngine:
 
     @staticmethod
     def _prefill_impl(cfg, mesh, params, ids, cache, prompt_lens, sample_args):
+        from llmss_tpu.models.decoder import forward
+
         B, S = ids.shape
         positions = jnp.broadcast_to(
             jnp.arange(S, dtype=jnp.int32), (B, S)
@@ -144,6 +151,8 @@ class DecodeEngine:
 
     @staticmethod
     def _decode_impl(cfg, mesh, params, tokens, cache, cur_pos, sample_args):
+        from llmss_tpu.models.decoder import forward
+
         # tokens [B], cur_pos [B] — position at which each token sits.
         positions = cur_pos[:, None]
         slots = positions % cache.max_len
@@ -174,6 +183,7 @@ class DecodeEngine:
         eos, *, n_steps: int,
     ):
         """Fused multi-token decode: lax.scan over the single-token step."""
+        from llmss_tpu.models.decoder import forward
 
         def body(carry, _):
             tokens, cache, cur_pos, done = carry

@@ -1,0 +1,68 @@
+"""Multi-process runtime: real jax.distributed rendezvous across processes.
+
+Round-3 verdict: the ``initialize_runtime`` multi-process branch
+(``parallel/mesh.py``, ≙ reference ``dist.py:65-73`` + the torchrun recipe
+in ``poc-server/producer-consumer/README.md:24-37``) had never been
+executed. This test launches two OS processes that rendezvous at a real
+coordinator, build a TP mesh spanning both, and run prefill + decode steps
+whose RowLinear psums and lm-head all-gather are genuine cross-process
+collectives (tools/multiprocess_smoke.py is the launch recipe).
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "tools", "multiprocess_smoke.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_runs_engine_step():
+    port = _free_port()
+    env = {
+        k: v for k, v in os.environ.items()
+        # The workers set their own platform/device-count flags; inherited
+        # pytest-session values would double-apply.
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, SCRIPT,
+                "--process-id", str(pid),
+                "--num-processes", "2",
+                "--coordinator", f"localhost:{port}",
+                "--local-devices", "2",
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=REPO,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out}"
+        assert f"mpsmoke ok pid={pid} processes=2 devices=4" in out, out
+
+    # Single-controller semantics: both processes computed the same global
+    # program — their greedy tokens must be identical.
+    toks = [re.search(r"toks=(\[[^\]]*\])", o).group(1) for o in outs]
+    assert toks[0] == toks[1], toks

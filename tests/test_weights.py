@@ -90,3 +90,48 @@ def test_concat_load_fused_qkv(ckpt_dir, devices):
     assert arr.shape == (48, 16)
     # Sharded on the concat axis: 6 rows per device, crossing source borders.
     assert arr.addressable_shards[0].data.shape == (6, 16)
+
+
+def test_multifile_checkpoint_end_to_end(tmp_path, devices):
+    """A MULTI-file sharded HF checkpoint (the real cold-start layout the
+    reference's loader routes, ``utils/weights.py:18-24``) loads through
+    ``load_model`` and matches HF logits — round 3 had only ever loaded
+    single-file checkpoints end-to-end."""
+    import torch
+    import transformers as tr
+
+    from llmss_tpu.engine import DecodeEngine, GenerationParams
+    from llmss_tpu.models.registry import load_model
+
+    torch.manual_seed(7)
+    hf_cfg = tr.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=192,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False,
+    )
+    model = tr.LlamaForCausalLM(hf_cfg).eval()
+    d = tmp_path / "sharded"
+    # ~160 KB shards force a genuinely multi-file layout with an index.
+    model.save_pretrained(d, safe_serialization=True, max_shard_size="160KB")
+    files = list(d.glob("*.safetensors"))
+    assert len(files) > 1, files
+    assert (d / "model.safetensors.index.json").exists()
+
+    mesh = make_mesh(MeshPlan(tp=8))
+    cfg, params = load_model(str(d), mesh, dtype="float32")
+    engine = DecodeEngine(cfg, params, mesh, max_seq_len=64)
+
+    ids = [[3, 17, 42, 9, 88, 21]]
+    with torch.no_grad():
+        ref = model(torch.tensor(ids)).logits[0, -1].float().numpy()
+    import jax.numpy as jnp
+
+    cache = engine.new_cache(1)
+    sa = engine._sample_args(GenerationParams(is_greedy=True), 1)
+    padded, lens = engine._pad_prompts(ids)
+    _, logits, _ = engine._prefill(
+        engine.params, jnp.asarray(padded), cache, jnp.asarray(lens), sa
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits)[0], ref, atol=2e-3, rtol=2e-3
+    )
