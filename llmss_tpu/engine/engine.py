@@ -97,6 +97,22 @@ class DecodeEngine:
         self.mesh = mesh
         self.batch_size = batch_size
         self.max_seq_len = max_seq_len or cfg.max_position_embeddings
+        if (
+            cfg.rope_original_max_positions is not None
+            and cfg.rope_freq_factors_short is not None
+        ):
+            # LongRoPE: the rotary basis follows the context this engine
+            # actually serves (models/phi3.py documents the contract) —
+            # a 4k-context engine on a 128k checkpoint runs the short
+            # factors, exactly as HF does for forwards within 4k.
+            import dataclasses as _dc
+
+            chosen = (
+                cfg.rope_freq_factors_long
+                if self.max_seq_len > cfg.rope_original_max_positions
+                else cfg.rope_freq_factors_short
+            )
+            cfg = self.cfg = _dc.replace(cfg, rope_freq_factors=chosen)
         # kv_dtype="int8" stores the cache quantized (per-token-per-head
         # scales): half the HBM footprint → double the rows/context per
         # chip. On sp=1 meshes the dequant scales fold into the attention
@@ -361,6 +377,7 @@ class DecodeEngine:
         gen: GenerationParams | list[GenerationParams],
         *,
         on_token=None,
+        on_increment=None,
         cancel_poll=None,
         chunk_steps: int = 1,
         live_rows: int | None = None,
@@ -370,8 +387,11 @@ class DecodeEngine:
         ``gen`` may be a list with one entry per prompt: a batch can mix
         greedy/sampled requests with different warpers, lengths, and EOS ids
         (the serving path; the reference hard-codes one config per batch).
-        ``on_token(step, tokens: np.ndarray)`` is called per step — the
-        serving layer streams from here. Stops early when every row is done.
+        ``on_token(step, tokens: np.ndarray)`` is called per step with the
+        raw batch tokens; ``on_increment(row, new_tokens: list[int])`` is
+        called only for tokens actually ACCEPTED into a row's output (EOS
+        and post-completion fills excluded) — the serving layer streams
+        from here with engine-owned completion semantics. Stops early when every row is done.
         ``cancel_poll() -> iterable[int]`` (optional) is polled for row
         indices whose clients went away: those rows stop accumulating
         tokens and count as done.
@@ -418,6 +438,18 @@ class DecodeEngine:
 
         step = 0
 
+        inc_buf: list[list[int]] = [[] for _ in range(B)]
+
+        def flush_increments() -> None:
+            # One on_increment per row per host round-trip (chunk): SSE /
+            # broker push costs scale with chunks, not tokens.
+            if on_increment is None:
+                return
+            for i in range(B):
+                if inc_buf[i]:
+                    on_increment(i, inc_buf[i])
+                    inc_buf[i] = []
+
         def process(tok_np) -> bool:
             """Account one step's tokens; returns True when all rows done."""
             nonlocal step
@@ -425,6 +457,8 @@ class DecodeEngine:
             for i in range(B):
                 if not done[i] and not newly_done[i]:
                     out[i].append(int(tok_np[i]))
+                    if on_increment is not None:
+                        inc_buf[i].append(int(tok_np[i]))
                     if len(out[i]) == max_new[i]:
                         done[i] = True
             done[:] = done | newly_done
@@ -434,6 +468,7 @@ class DecodeEngine:
             return bool(done.all())
 
         process(np.asarray(tok))
+        flush_increments()
         while not done.all() and step < total_steps:
             if cancel_poll is not None:
                 for i in cancel_poll():
@@ -458,6 +493,7 @@ class DecodeEngine:
                     tok.block_until_ready()
                 cur_pos = cur_pos + 1
                 process(np.asarray(tok))
+                flush_increments()
             else:
                 t0 = time.perf_counter()
                 toks, cache, cur_pos, _ = self._decode_many(
@@ -472,6 +508,7 @@ class DecodeEngine:
                 for col in range(k):
                     if process(chunk_np[:, col]):
                         break
+                flush_increments()
         self.metrics.add_tokens(
             sum(len(o) for o in out[: live_rows or B])
         )

@@ -58,6 +58,21 @@ class DecoderConfig:
     rope_style: str = "interleaved"  # "interleaved" | "half"
     rotary_dim: int | None = None  # partial rotary (config.rotary_dim, GPT-J)
     rope_theta: float = 10000.0
+    # LongRoPE (Phi-3 long-context): per-frequency divisors of length
+    # rotary_dim/2 — inv_freq_i = 1 / (factor_i * theta^(2i/d)) — and a
+    # scalar multiplier on sin/cos (the paper's attention factor). Chosen
+    # STATICALLY at config time (models/phi3.py) rather than by runtime
+    # sequence length as HF does: a basis switch mid-decode would poison
+    # the incremental KV cache.
+    rope_freq_factors: tuple[float, ...] | None = None
+    rope_attn_factor: float = 1.0
+    # Both LongRoPE bases + the original (pre-extension) window, so the
+    # ENGINE can pick the basis matching its actual configured context
+    # (DecodeEngine.__init__): a 4k-context engine on a 128k checkpoint
+    # uses the short factors exactly as HF does for <=4k forwards.
+    rope_freq_factors_short: tuple[float, ...] | None = None
+    rope_freq_factors_long: tuple[float, ...] | None = None
+    rope_original_max_positions: int | None = None
 
     # Sliding-window attention (Mistral): each token attends only the last
     # ``sliding_window`` positions. None = full causal. The ring-buffer

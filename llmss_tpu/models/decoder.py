@@ -503,7 +503,8 @@ def forward(
     sin_cos = None
     if cfg.positions == "rotary":
         sin_cos = sin_cos_tables(
-            positions, cfg.rotary_dim or cfg.head_dim, cfg.rope_theta
+            positions, cfg.rotary_dim or cfg.head_dim, cfg.rope_theta,
+            cfg.rope_freq_factors, cfg.rope_attn_factor,
         )
     # Single-token decode defers all KV writes to one batched scatter after
     # the layer scan (TPU scatter cost is per-op; L in-scan scatters were

@@ -23,15 +23,28 @@ import jax
 import jax.numpy as jnp
 
 
-def sin_cos_tables(positions: jax.Array, dim: int, theta: float):
+def sin_cos_tables(
+    positions: jax.Array, dim: int, theta: float,
+    freq_factors=None, attn_factor: float = 1.0,
+):
     """sin/cos [B, S, dim/2] in fp32 for integer positions — the tables
     ``apply_rope`` consumes. Public so the decode scan can compute them
-    once per step and pass them to every layer (models/decoder.py)."""
+    once per step and pass them to every layer (models/decoder.py).
+
+    ``freq_factors`` (length dim/2) are LongRoPE's per-frequency divisors
+    and ``attn_factor`` its scalar sin/cos multiplier
+    (DecoderConfig.rope_freq_factors / rope_attn_factor)."""
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim)
     )
+    if freq_factors is not None:
+        inv_freq = inv_freq / jnp.asarray(freq_factors, jnp.float32)
     angles = positions[..., None].astype(jnp.float32) * inv_freq
-    return jnp.sin(angles), jnp.cos(angles)
+    sin, cos = jnp.sin(angles), jnp.cos(angles)
+    if attn_factor != 1.0:
+        sin = sin * attn_factor
+        cos = cos * attn_factor
+    return sin, cos
 
 
 def apply_rope(
@@ -42,6 +55,8 @@ def apply_rope(
     theta: float = 10000.0,
     style: str = "interleaved",
     sin_cos: tuple[jax.Array, jax.Array] | None = None,
+    freq_factors=None,
+    attn_factor: float = 1.0,
 ) -> jax.Array:
     """Rotate the first ``rotary_dim`` features of each head by position.
 
@@ -56,7 +71,7 @@ def apply_rope(
     rotary_dim = rotary_dim or D
     rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
     sin, cos = sin_cos if sin_cos is not None else sin_cos_tables(
-        positions, rotary_dim, theta
+        positions, rotary_dim, theta, freq_factors, attn_factor
     )
     sin = sin[:, :, None, :]  # broadcast over heads
     cos = cos[:, :, None, :]

@@ -163,9 +163,17 @@ class Worker:
                 mid_cancelled.update(hits)
             return [i for i, r in enumerate(ok) if r.id in hits]
 
+        def on_increment(row, new_toks):
+            # True streaming from the batch worker: increments go out at
+            # decode-chunk granularity, with engine-owned completion
+            # semantics (EOS / max-token fills never leak).
+            if row < n_live and ok[row].stream:
+                self.broker.push_stream(ok[row].id, new_toks)
+
         try:
             outs = self.engine.generate(
                 prompts, gens, cancel_poll=cancel_poll,
+                on_increment=on_increment,
                 chunk_steps=self.chunk_steps, live_rows=n_live,
             )[:n_live]
         except Exception as e:  # noqa: BLE001 — batch failure containment
@@ -181,12 +189,6 @@ class Worker:
             return len(batch)
 
         for req, toks in zip(ok, outs):
-            if req.stream:
-                # The batch worker has no per-chunk hook; degrade to one
-                # increment at completion so SSE clients still get their
-                # data event before done (use --continuous for true
-                # incremental delivery).
-                self.broker.push_stream(req.id, toks)
             if req.id in mid_cancelled:
                 # The client is by definition gone — an honest "cancelled"
                 # error (with the partial tokens), not a fake success.

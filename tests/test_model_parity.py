@@ -185,3 +185,120 @@ def test_incremental_decode_parity(tmp_path, devices, model_type):
         np.testing.assert_allclose(
             np.asarray(logits[:, 0]), ref[:, t], atol=2e-4, rtol=2e-3
         )
+
+
+def test_phi3_longrope_parity_straddling_original_window(tmp_path, devices):
+    """Phi-3 LongRoPE (rope_scaling 'longrope'): logits must match HF for a
+    forward that STRADDLES original_max_position_embeddings — HF selects
+    the long factors for that whole forward, and the static config-time
+    choice (max_position_embeddings > original → long) agrees. Incremental
+    decode must continue the same basis across the boundary."""
+    import torch
+    import transformers as tr
+
+    from llmss_tpu.engine import DecodeEngine, GenerationParams
+
+    half = 4  # head_dim 8 → 4 frequencies
+    torch.manual_seed(3)
+    hf_cfg = tr.Phi3Config(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=48, max_position_embeddings=32,
+        original_max_position_embeddings=8,
+        rope_scaling={
+            "type": "longrope",
+            "short_factor": [1.0 + 0.1 * i for i in range(half)],
+            "long_factor": [2.0 + 0.5 * i for i in range(half)],
+        },
+        tie_word_embeddings=False, pad_token_id=0,
+    )
+    model = tr.Phi3ForCausalLM(hf_cfg).eval()
+    d = tmp_path / "phi3-longrope"
+    model.save_pretrained(d, safe_serialization=True)
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 64, size=(1, 12))  # 12 > original_max 8
+
+    mesh = make_mesh(MeshPlan(dp=2, tp=4))
+    from transformers import AutoConfig
+
+    cfg = config_from_hf(AutoConfig.from_pretrained(d), dtype="float32")
+    assert cfg.rope_freq_factors is not None
+    assert len(cfg.rope_freq_factors) == half
+    assert cfg.rope_freq_factors[0] == 2.0  # long (32 > 8)
+    assert cfg.rope_attn_factor > 1.0
+
+    ckpt = CheckpointShards(weight_files(str(d)), dtype=np.float32)
+    params = MODEL_REGISTRY["phi3"].load_params(ckpt, cfg, mesh)
+
+    # Full forward straddling the original window: HF picks long factors
+    # for every position of this seq_len=12 call — exact agreement.
+    ref = _hf_logits(model, ids)
+    S12 = ids.shape[1]
+    cache = init_cache(
+        mesh, n_layers=cfg.n_layers, batch=1, max_len=16,
+        n_kv_heads=cfg.n_kv_heads, head_dim=cfg.head_dim,
+        dtype=jnp.float32,
+    )
+    positions = jnp.broadcast_to(jnp.arange(S12), (1, S12))
+    logits, _ = jax.jit(forward, static_argnums=0)(
+        cfg, params, jnp.asarray(ids), positions, cache, positions % 16
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), ref, atol=2e-4, rtol=2e-3
+    )
+
+    # Incremental decode crossing the boundary: greedy continuation from a
+    # 6-token prompt through position 14 must match HF's cache-free
+    # re-forward argmax at each step beyond the original window (both use
+    # the long basis there; the engine never switches basis mid-stream).
+    engine = DecodeEngine(cfg, params, mesh, max_seq_len=16)
+    prompt = ids[0, :6].tolist()
+    out = engine.generate(
+        [prompt], GenerationParams(max_new_tokens=8, is_greedy=True)
+    )[0]
+    prefix = list(prompt)
+    for step, tok in enumerate(out):
+        full = np.asarray([prefix])
+        if full.shape[1] > 8:  # straddles: HF uses the long basis too
+            hf_tok = int(_hf_logits(model, full)[0, -1].argmax())
+            assert tok == hf_tok, (step, tok, hf_tok, prefix)
+        prefix.append(tok)
+
+
+def test_phi3_longrope_engine_picks_basis_from_its_context(tmp_path, devices):
+    """A short-context engine on a long-context LongRoPE checkpoint must run
+    the SHORT factors (what HF uses for every forward such an engine can
+    serve), and a long-context engine the long factors."""
+    import torch
+    import transformers as tr
+
+    from llmss_tpu.engine import DecodeEngine
+    from llmss_tpu.models.decoder import init_params
+
+    half = 4
+    hf_cfg = tr.Phi3Config(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=48, max_position_embeddings=32,
+        original_max_position_embeddings=8,
+        rope_scaling={
+            "type": "longrope",
+            "short_factor": [1.0] * half,
+            "long_factor": [4.0] * half,
+        },
+        tie_word_embeddings=False, pad_token_id=0,
+    )
+    d = tmp_path / "m"
+    torch.manual_seed(0)
+    tr.Phi3ForCausalLM(hf_cfg).save_pretrained(d, safe_serialization=True)
+    from transformers import AutoConfig
+
+    cfg = config_from_hf(AutoConfig.from_pretrained(d), dtype="float32")
+    mesh = make_mesh(MeshPlan(dp=2, tp=4))
+    params = init_params(cfg, mesh, jax.random.key(0))
+
+    short_engine = DecodeEngine(cfg, params, mesh, max_seq_len=8)
+    long_engine = DecodeEngine(cfg, params, mesh, max_seq_len=16)
+    assert short_engine.cfg.rope_freq_factors == (1.0,) * half
+    assert long_engine.cfg.rope_freq_factors == (4.0,) * half

@@ -422,3 +422,37 @@ def test_streaming_sse_roundtrip(serving):
     finally:
         stop.set()
         server.stop()
+
+
+def test_streaming_from_batch_worker_is_incremental(serving):
+    """The STATIC (batch-at-a-time) Worker streams too: stream:true must
+    deliver >1 increment per request (round 3 degraded to one blob at
+    completion), with engine-owned completion semantics — increments
+    concatenate to exactly the final response tokens."""
+    _, engine = serving
+    broker = InProcBroker()
+    worker = Worker(
+        engine, broker, batch_size=2, poll_timeout_s=0.01, chunk_steps=2
+    )
+    broker.push_request(GenerateRequest(
+        id="s1", token_ids=[5, 6, 7], max_new_tokens=10, is_greedy=True,
+        stream=True,
+    ))
+    broker.push_request(GenerateRequest(
+        id="p1", token_ids=[5, 6, 7], max_new_tokens=10, is_greedy=True,
+    ))
+    worker.run_once()
+
+    done = broker.wait_response("s1", timeout=5)
+    plain = broker.wait_response("p1", timeout=5)
+    assert done is not None and done.error is None
+
+    events = []
+    while True:
+        inc = broker.pop_stream("s1", timeout=0.05)
+        if inc is None:
+            break
+        events.append(inc)
+    assert len(events) >= 2, events  # actually incremental, not one blob
+    streamed = [t for inc in events for t in inc]
+    assert streamed == done.token_ids == plain.token_ids
