@@ -206,3 +206,86 @@ def test_sampling_per_row_seed_determinism():
         top_p=jnp.ones(1), greedy=jnp.zeros(1, bool),
     )
     assert int(solo[0]) == int(t[0])
+
+
+def test_sampling_topk_bucket_matches_full_sort():
+    """The static top-k bucket path and the full-sort fallback must draw
+    identical tokens for rows they both serve: a row's draw is batch-mix
+    independent, so adding one bucket-busting row (top_k > TOPK_BUCKET)
+    flips the whole batch to the full sort without changing any other
+    row's token."""
+    from llmss_tpu.ops.sampling import TOPK_BUCKET
+
+    rng = np.random.default_rng(3)
+    V = 512
+    logits = jnp.asarray(rng.normal(size=(4, V)) * 3, jnp.float32)
+    kw = dict(
+        temperature=jnp.full(4, 0.8),
+        top_k=jnp.asarray([40, 0, 5, 40], jnp.int32),
+        top_p=jnp.asarray([1.0, 0.9, 0.95, 0.7], jnp.float32),
+        greedy=jnp.zeros(4, bool),
+    )
+    a = np.asarray(sample(logits, **_sargs(4, seed=11), **kw))
+
+    # Same rows + a fifth row whose top_k exceeds the bucket: the batch
+    # falls back to the full sort; shared rows must not move. (Peaked
+    # logits keep the top_p rows resolvable in-bucket for run A.)
+    logits_b = jnp.concatenate([logits, logits[:1]], axis=0)
+    kw_b = dict(
+        temperature=jnp.full(5, 0.8),
+        top_k=jnp.asarray(
+            [40, 0, 5, 40, TOPK_BUCKET + 100], jnp.int32
+        ),
+        top_p=jnp.asarray([1.0, 0.9, 0.95, 0.7, 0.999], jnp.float32),
+        greedy=jnp.zeros(5, bool),
+    )
+    b = np.asarray(sample(
+        logits_b, seeds=jnp.full(5, 11, jnp.int32),
+        counters=jnp.zeros(5, jnp.int32), **kw_b,
+    ))
+    np.testing.assert_array_equal(a, b[:4])
+
+
+def test_sampling_bucket_fallback_on_flat_nucleus():
+    """Near-uniform logits with a high top_p cannot resolve the nucleus
+    inside the bucket — the runtime guard must take the full sort, and the
+    draw stays deterministic and within the nucleus-eligible set."""
+    V = 512
+    logits = jnp.zeros((2, V), jnp.float32)  # uniform: mass(bucket) = Kb/V
+    kw = dict(
+        temperature=jnp.ones(2),
+        top_k=jnp.zeros(2, jnp.int32),
+        top_p=jnp.full(2, 0.99),
+        greedy=jnp.zeros(2, bool),
+    )
+    a = np.asarray(sample(logits, **_sargs(2, seed=5), **kw))
+    b = np.asarray(sample(logits, **_sargs(2, seed=5), **kw))
+    np.testing.assert_array_equal(a, b)
+    # uniform + top_p=0.99 keeps ~507 of 512 tokens; any id is plausible,
+    # but it must be a valid token id.
+    assert ((a >= 0) & (a < V)).all()
+
+
+def test_sampling_unfiltered_row_keeps_full_vocab_in_mixed_batch():
+    """A warper-free sampled row sharing a batch with a filtered row must
+    draw over the FULL vocab (not the top-k bucket): its token equals its
+    solo draw exactly."""
+    rng = np.random.default_rng(9)
+    V = 512
+    row = jnp.asarray(rng.normal(size=(1, V)), jnp.float32)
+    solo = int(sample(
+        row, **_sargs(1, seed=21),
+        temperature=jnp.full(1, 3.0),
+        top_k=jnp.zeros(1, jnp.int32), top_p=jnp.ones(1),
+        greedy=jnp.zeros(1, bool),
+    )[0])
+    mixed = np.asarray(sample(
+        jnp.concatenate([row, row], axis=0),
+        seeds=jnp.asarray([21, 22], jnp.int32),
+        counters=jnp.zeros(2, jnp.int32),
+        temperature=jnp.full(2, 3.0),
+        top_k=jnp.asarray([0, 5], jnp.int32),
+        top_p=jnp.ones(2),
+        greedy=jnp.zeros(2, bool),
+    ))
+    assert mixed[0] == solo
