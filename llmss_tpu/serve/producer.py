@@ -215,10 +215,51 @@ class ProducerServer:
 
 
 def create_fastapi_app(broker: Broker, timeout_s: float = 300.0):
-    """FastAPI variant of the producer (optional dependency, gated)."""
+    """FastAPI variant of the producer (optional dependency, gated).
+
+    Full API parity with ``ProducerServer``: POST /generate (JSON or SSE
+    streaming via ``stream: true``, same event format), POST /cancel,
+    GET /metrics, GET /health."""
+    import time as _time
+
     from fastapi import FastAPI, HTTPException
+    from fastapi.responses import StreamingResponse
 
     app = FastAPI()
+
+    def _sse(req: GenerateRequest):
+        """SSE generator matching ProducerServer._stream_response: one
+        ``data:`` event per token increment, then a ``done`` event with
+        the terminal response. Client disconnect (GeneratorExit) cancels
+        the request so the worker stops spending decode steps on it."""
+        deadline = _time.monotonic() + timeout_s
+        try:
+            while _time.monotonic() < deadline:
+                inc = broker.pop_stream(req.id, timeout=0.1)
+                if inc is not None:
+                    yield (
+                        "data: " + json.dumps({"token_ids": inc}) + "\n\n"
+                    )
+                    continue
+                resp = broker.wait_response(req.id, timeout=0.05)
+                if resp is not None:
+                    while True:  # drain increments that raced the response
+                        inc = broker.pop_stream(req.id)
+                        if inc is None:
+                            break
+                        yield (
+                            "data: " + json.dumps({"token_ids": inc})
+                            + "\n\n"
+                        )
+                    yield "event: done\ndata: " + resp.to_json() + "\n\n"
+                    return
+            broker.cancel_request(req.id)
+            yield 'event: error\ndata: {"error": "timed out"}\n\n'
+        except GeneratorExit:
+            broker.cancel_request(req.id)
+            raise
+        finally:
+            broker.drop_stream(req.id)
 
     @app.post("/generate")
     def generate(payload: dict):
@@ -227,21 +268,31 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0):
             req.validate()
         except ValueError as e:
             raise HTTPException(400, str(e)) from e
-        if req.stream:
-            # SSE streaming lives on the stdlib ProducerServer; answering
-            # a stream request with plain JSON would silently break the
-            # client's parser.
-            raise HTTPException(
-                400, "stream=true is not supported by the FastAPI "
-                     "producer variant; use ProducerServer"
-            )
         broker.push_request(req)
+        if req.stream:
+            return StreamingResponse(
+                _sse(req), media_type="text/event-stream",
+                headers={"Cache-Control": "no-cache"},
+            )
         resp = broker.wait_response(req.id, timeout_s)
         if resp is None:
+            broker.cancel_request(req.id)
             raise HTTPException(504, "timed out")
         if resp.error:
             raise HTTPException(500, resp.error)
         return json.loads(resp.to_json())
+
+    @app.post("/cancel")
+    def cancel(payload: dict):
+        rid = payload.get("id")
+        if not rid:
+            raise HTTPException(400, "missing id")
+        broker.cancel_request(rid)
+        return {"cancelled": rid}
+
+    @app.get("/metrics")
+    def metrics():
+        return broker.read_metrics()
 
     @app.get("/health")
     def health():
