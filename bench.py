@@ -1,6 +1,9 @@
 """Benchmark: decode throughput (tokens/sec/chip) on the flagship model.
 
-Run on real TPU hardware by the driver. Prints ONE JSON line:
+Run on real TPU hardware by the driver. Prints ONE JSON line per benched
+config — the HEADLINE LAST: **Llama-2-7B dims, the BASELINE.md
+north-star scale** (the ~1.2B lines print first: the series tracked since
+round 1, kept for cross-round comparability, plus its int8-KV variant):
 ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
 
 The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
@@ -11,25 +14,30 @@ bytes from HBM, so
     roofline_tokens_per_sec = batch * BW / (param_bytes + batch * kv_bytes)
 
 ``vs_baseline`` = measured / roofline — i.e. the fraction of the chip's
-theoretical decode ceiling this framework reaches (1.0 is perfect).
+theoretical decode ceiling this framework reaches (1.0 is perfect), with
+``kv_bytes`` accounted at an average half-full ring in bf16.
 
 Methodology: steady-state decode cost is the **marginal** time per fused
-decode step, measured by the slope method — run the fused scan at two step
+decode step, measured by the slope method — run the decode at two step
 counts and take (t(N2) - t(N1)) / (N2 - N1). This cancels constant per-call
 overhead (on the axon bench host the tunnel adds ~90 ms of dispatch + fetch
 latency per call, which is host-link artifact, not framework cost) and
-matches what a long-running serving process sustains. Prefill latency is
-its own number (TTFT, reported in ``unit``), not smeared into decode
-throughput. As an independent cross-check on the roofline accounting, the
-achieved HBM rate implied by the measured step time over the bytes the step
-must stream (params + full KV buffer) is also reported in ``unit``.
+matches what a long-running serving process sustains. The decode runs in
+CHUNK-step fused scans chained back-to-back (dispatches are async — no host
+sync between chunks), exactly like the serving path, so the engine's
+**bucketed cache reads** are measured: each chunk reads only the ring
+prefix covering the rows' live context (engine.decode_bucket), not the
+whole provisioned ring. The ring (``MAX_SEQ``) is sized so the slope window
+never wraps — positions stay inside the advertised context. Prefill
+latency is its own number (TTFT, reported in ``unit``), not smeared into
+decode throughput. As an independent cross-check, ``unit`` also reports
+the achieved HBM rate implied by the measured step time over the bytes the
+step actually streams (params + the mean bucketed KV prefix).
 
-Model: Llama-architecture ~1.2B by default (fits one v5e with generous
-cache room; the headline series tracked across rounds), random-init bf16,
-batch 16, 128-token prefill, fused decode. ``BENCH_MODEL=7b`` switches to
-Llama-2-7B dims — the BASELINE.md north-star scale — which reaches a
-*higher* roofline fraction (params dominate the denominator): 0.851 at
-batch 4, 203 tok/s/chip, TTFT 129 ms (measured r3).
+Models: Llama-architecture ~1.2B (the series tracked across rounds, plus
+its int8-KV variant) and Llama-2-7B dims — the BASELINE.md north-star
+scale and the headline, printed last — all random-init bf16 weights.
+``BENCH_MODEL=1b2|7b`` restricts to one.
 """
 
 from __future__ import annotations
@@ -42,36 +50,43 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-# Batch 16 is the headline point (vs_baseline peaks there: params dominate
-# the roofline denominator). Batch 32 still holds TTFT under the BASELINE.md
-# 200 ms target with higher absolute throughput (5785 tok/s/chip, ttft
-# 163 ms measured r3) — BENCH_BATCH=32 reproduces it. BENCH_KV_DTYPE=int8
-# halves cache memory (2x rows/context) at a dequant-overhead cost.
-BATCH = int(os.environ.get("BENCH_BATCH", 16))
+# Batch 16 is the 1b2 headline point (vs_baseline peaks there: params
+# dominate the roofline denominator); 7B runs batch 4 (params + cache fill
+# the chip). BENCH_KV_DTYPE=int8 halves cache memory (2x rows/context).
 PROMPT = int(os.environ.get("BENCH_PROMPT", 128))
 DECODE = int(os.environ.get("BENCH_DECODE", 128))
 HBM_GBPS = float(os.environ.get("BENCH_HBM_GBPS", 819.0))  # v5e
 KV_DTYPE = os.environ.get("BENCH_KV_DTYPE") or None  # "int8" halves KV bytes
+CHUNK = int(os.environ.get("BENCH_CHUNK", 32))  # serving-path fused chunk
 
-
-MODEL = os.environ.get("BENCH_MODEL", "1b2")  # "1b2" | "7b"
+MODEL = os.environ.get("BENCH_MODEL")  # "1b2" | "7b" | None = both
 
 _MODEL_DIMS = {
     # ~1.2B: the headline config — fits one v5e with generous cache room.
     "1b2": dict(hidden_size=2048, n_layers=20, n_heads=16,
                 intermediate_size=5504),
     # Llama-2-7B dims (BASELINE.md north-star scale): 13.5 GB bf16 params
-    # on a 16 GB v5e — single-chip analogue of the TP=8 config (run with
-    # BENCH_BATCH=4; larger batches don't fit beside the params).
+    # on a 16 GB v5e — single-chip analogue of the TP=8 config.
     "7b": dict(hidden_size=4096, n_layers=32, n_heads=32,
                intermediate_size=11008),
 }
 
+# Per-model operating point: batch and slope-method step counts (the 7B
+# window is shorter because its params already fill 13.5 of 16 GB). The
+# ring is derived as PROMPT + n_slope[1] so the slope window never wraps,
+# whatever BENCH_PROMPT is set to.
+_MODEL_RUN = {
+    "1b2": dict(batch=16, n_slope=(64, 320)),
+    "7b": dict(batch=4, n_slope=(32, 224)),
+}
 
-def flagship_cfg():
+BATCH = int(os.environ.get("BENCH_BATCH", 0))  # 0 = per-model default
+
+
+def flagship_cfg(model: str = "1b2"):
     from llmss_tpu.models.common import DecoderConfig
 
-    dims = _MODEL_DIMS[MODEL]
+    dims = _MODEL_DIMS[model]
     return DecoderConfig(
         model_type="llama",
         vocab_size=32000,
@@ -93,9 +108,6 @@ def flagship_cfg():
     )
 
 
-N_SLOPE = (64, 320)  # fused-scan step counts for the slope method
-
-
 def roofline_tokens_per_sec(
     cfg, param_bytes: float, batch: int, max_seq: int,
     hbm_gbps: float = HBM_GBPS,
@@ -111,11 +123,13 @@ def roofline_tokens_per_sec(
     )
 
 
-def slope_time(prepare, n_slope=N_SLOPE, reps: int = 3) -> tuple[float, float]:
+def slope_time(
+    prepare, n_slope=(64, 320), reps: int = 3
+) -> tuple[float, float]:
     """Marginal ms per decode step + constant ms, via the slope method.
 
-    ``prepare(n)`` must return a zero-arg callable that runs one fused
-    n-step scan **to completion** — force it with a host fetch of a scalar
+    ``prepare(n)`` must return a zero-arg callable that runs one n-step
+    decode **to completion** — force it with a host fetch of a scalar
     reduction; ``block_until_ready`` can return at dispatch time over the
     axon tunnel. The single methodology shared by bench.py and
     tools/profile_decode.py.
@@ -136,59 +150,95 @@ def slope_time(prepare, n_slope=N_SLOPE, reps: int = 3) -> tuple[float, float]:
     return slope_ms, const_ms
 
 
-def _decode_slope_ms(engine, ids, lens, sa, eos) -> float:
+def chunk_schedule(engine, start_pos: int, n_steps: int, chunk: int):
+    """The (n_steps_in_chunk, t_bucket) sequence a chained-chunk decode of
+    ``n_steps`` runs, starting with every row at ``start_pos``. Shared by
+    the runner and the achieved-bandwidth accounting."""
+    out = []
+    pos = start_pos
+    left = n_steps
+    while left > 0:
+        k = min(chunk, left)
+        out.append((k, engine.decode_bucket(pos + k)))
+        pos += k
+        left -= k
+    return out
+
+
+def _decode_slope_ms(engine, ids, lens, sa, eos, batch, n_slope):
+    """Serving-path decode: chained CHUNK-step fused scans with bucketed
+    cache reads, dispatched back-to-back (async), one forcing fetch at the
+    end. Marginal cost via the slope method."""
+    done = jnp.zeros(batch, bool)
+
     def prepare(n):
-        cache = engine.new_cache(BATCH)
-        tok, _, cache = engine._prefill(
+        cache = engine.new_cache(batch)
+        tok0, _, cache = engine._prefill(
             engine.params, jnp.asarray(ids), cache, jnp.asarray(lens), sa,
         )
-        cur = jnp.asarray(lens)
-        done = jnp.zeros(BATCH, bool)
+        tok0 = engine.canon_vec(tok0)
+        cache = engine.canon_cache(cache)
+        cur0 = engine.canon_vec(jnp.asarray(lens))
+        sched = chunk_schedule(engine, int(lens.max()), n, CHUNK)
         state = {"cache": cache}
 
         def run():
-            out = engine._decode_many(
-                engine.params, tok, state["cache"], cur, sa, done, eos,
-                n_steps=n,
-            )
-            toks, state["cache"] = out[0], out[1]
-            _ = float(jnp.sum(toks))  # forced completion
+            cache = state["cache"]
+            tok, cur = tok0, cur0
+            total = jnp.zeros((), jnp.int32)
+            for k, tb in sched:
+                toks, cache, cur, _ = engine._decode_many(
+                    engine.params, tok, cache, cur, sa, done, eos,
+                    n_steps=k, t_bucket=tb,
+                )
+                cache = engine.canon_cache(cache)
+                cur = engine.canon_vec(cur)
+                tok = engine.canon_vec(toks[:, -1])
+                total = total + jnp.sum(toks)
+            state["cache"] = cache
+            _ = int(total)  # forced completion
 
         return run
 
-    return slope_time(prepare)[0]
+    return slope_time(prepare, n_slope)
 
 
-def main():
+def run_model(model: str, kv_dtype: str | None = KV_DTYPE) -> dict:
     from llmss_tpu.engine import DecodeEngine, GenerationParams
     from llmss_tpu.models.decoder import init_params
     from llmss_tpu.parallel import MeshPlan, make_mesh
 
+    run_cfg = _MODEL_RUN[model]
+    batch = BATCH or run_cfg["batch"]
+    n_slope = run_cfg["n_slope"]
+    max_seq = int(os.environ.get("BENCH_MAX_SEQ", 0)) or (
+        PROMPT + n_slope[1]
+    )
+
     n_dev = len(jax.devices())
     mesh = make_mesh(MeshPlan(tp=n_dev))
-    cfg = flagship_cfg()
+    cfg = flagship_cfg(model)
     params = init_params(cfg, mesh, jax.random.key(0))
     n_params = sum(
         np.prod(x.shape) for x in jax.tree.leaves(params)
     )
     param_bytes = float(n_params) * 2  # bf16
 
-    max_seq = PROMPT + DECODE
     engine = DecodeEngine(
-        cfg, params, mesh, max_seq_len=max_seq, kv_dtype=KV_DTYPE,
+        cfg, params, mesh, max_seq_len=max_seq, kv_dtype=kv_dtype,
     )
     gen = GenerationParams(max_new_tokens=DECODE, is_greedy=True)
 
     rng = np.random.default_rng(0)
     prompts = [
-        rng.integers(0, cfg.vocab_size, PROMPT).tolist() for _ in range(BATCH)
+        rng.integers(0, cfg.vocab_size, PROMPT).tolist() for _ in range(batch)
     ]
     ids, lens = engine._pad_prompts(prompts)
-    sa = engine._sample_args(gen, BATCH)
-    eos = jnp.int32(-1)
+    sa = engine._sample_args(gen, batch)
+    eos = engine.canon_vec(jnp.full(batch, -1, jnp.int32))
 
     # Warmup: compile prefill once.
-    cache = engine.new_cache(BATCH)
+    cache = engine.new_cache(batch)
     tok, _, cache = engine._prefill(
         engine.params, jnp.asarray(ids), cache, jnp.asarray(lens), sa,
     )
@@ -198,7 +248,7 @@ def main():
     # TTFT: prefill + first sampled token on host, compiled path.
     ttft_ms = float("inf")
     for _i in range(3):
-        cache = engine.new_cache(BATCH)
+        cache = engine.new_cache(batch)
         t0 = time.perf_counter()
         tok, _, cache = engine._prefill(
             engine.params, jnp.asarray(ids), cache, jnp.asarray(lens), sa,
@@ -207,30 +257,77 @@ def main():
         ttft_ms = min(ttft_ms, (time.perf_counter() - t0) * 1e3)
         del cache
 
-    # Decode throughput: marginal fused-step cost, steady state.
-    step_ms = _decode_slope_ms(engine, ids, lens, sa, eos)
-    tok_per_sec_per_chip = BATCH / (step_ms * 1e-3) / n_dev
+    # Decode throughput: marginal chained-chunk cost, steady state.
+    step_ms, _ = _decode_slope_ms(engine, ids, lens, sa, eos, batch, n_slope)
+    tok_per_sec_per_chip = batch / (step_ms * 1e-3) / n_dev
 
-    roofline = roofline_tokens_per_sec(cfg, param_bytes, BATCH, max_seq)
-    # Independent cross-check: the step must stream at least params + the
-    # full KV buffer (einsums read all T slots of the ring buffer); the
-    # achieved HBM rate over those bytes bounds the accounting from below.
-    kv_buffer_bytes = 2 * cfg.n_layers * BATCH * max_seq * (
-        cfg.n_kv_heads * cfg.head_dim * 2
-    )
-    achieved_gbps = (param_bytes + kv_buffer_bytes) / (step_ms * 1e-3) / 1e9
-    result = {
+    # Sampled decode (BASELINE config #3): same slope with every row
+    # running temperature + top-k + top-p through the static top-k bucket
+    # path (ops/sampling.py) — must stay within a few % of greedy.
+    sampled_ms = None
+    if kv_dtype is None:
+        gen_s = GenerationParams(
+            max_new_tokens=DECODE, is_greedy=False, temperature=0.8,
+            top_k=40, top_p=0.95, seed=1,
+        )
+        sa_s = engine._sample_args(gen_s, batch)
+        sampled_ms, _ = _decode_slope_ms(
+            engine, ids, lens, sa_s, eos, batch, n_slope
+        )
+
+    roofline = roofline_tokens_per_sec(cfg, param_bytes, batch, max_seq)
+    # Independent cross-check: achieved HBM rate over the bytes a step in
+    # the slope window actually streams — params + the mean bucketed KV
+    # prefix (the full ring where no bucket applied).
+    kv_token_bytes = 2 * cfg.n_layers * batch * (
+        cfg.n_kv_heads * cfg.head_dim
+    ) * (1 if kv_dtype == "int8" else 2)
+    n1, n2 = n_slope
+    per_step = []
+    for k, tb in chunk_schedule(engine, int(lens.max()), n2, CHUNK):
+        per_step += [tb if tb is not None else max_seq] * k
+    mean_kv_bytes = kv_token_bytes * float(np.mean(per_step[n1:n2]))
+    achieved_gbps = (param_bytes + mean_kv_bytes) / (step_ms * 1e-3) / 1e9
+    return {
         "metric": "decode_tokens_per_sec_per_chip",
         "value": round(tok_per_sec_per_chip, 1),
         "unit": (
-            f"tok/s/chip ({MODEL} bf16, batch={BATCH}, "
-            + (f"kv={KV_DTYPE}, " if KV_DTYPE else "")
-            + f"ttft_ms={ttft_ms:.0f}, "
-            f"step_ms={step_ms:.2f}, achieved_hbm_gbps={achieved_gbps:.0f})"
+            f"tok/s/chip ({model} bf16, batch={batch}, "
+            + (f"kv={kv_dtype}, " if kv_dtype else "")
+            + f"ring={max_seq}, ttft_ms={ttft_ms:.0f}, "
+            f"step_ms={step_ms:.2f}, "
+            + (
+                f"sampled_step_ms={sampled_ms:.2f}, "
+                if sampled_ms is not None else ""
+            )
+            + f"achieved_hbm_gbps={achieved_gbps:.0f})"
         ),
         "vs_baseline": round(tok_per_sec_per_chip / roofline, 3),
     }
-    print(json.dumps(result))
+
+
+def main():
+    # Default sweep: the 1b2 series (bf16 — comparable across rounds —
+    # and int8 KV: half the cache bytes, scales folded into the attention
+    # contractions), then the HEADLINE LAST: Llama-2-7B dims, the
+    # BASELINE.md north-star scale. BENCH_MODEL (optionally with
+    # BENCH_KV_DTYPE) restricts to that single line; BENCH_KV_DTYPE alone
+    # restricts to a single 1b2 line in that dtype.
+    if MODEL:
+        runs = [(MODEL, KV_DTYPE)]
+    elif KV_DTYPE:
+        runs = [("1b2", KV_DTYPE)]
+    else:
+        runs = [("1b2", None), ("1b2", "int8"), ("7b", None)]
+    for model, kv in runs:
+        result = run_model(model, kv)
+        print(json.dumps(result), flush=True)
+        # Free this model's params/executables before the next config —
+        # 7B params alone are 13.5 GB of the 16 GB chip.
+        jax.clear_caches()
+        import gc
+
+        gc.collect()
 
 
 if __name__ == "__main__":

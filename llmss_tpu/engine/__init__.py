@@ -11,6 +11,6 @@ host↔device round trips beyond fetching the emitted token.
 """
 
 from llmss_tpu.engine.cache import KVCache
-from llmss_tpu.engine.engine import DecodeEngine, GenerationParams
+from llmss_tpu.engine.engine import DecodeEngine, GenerationParams, Prefix
 
-__all__ = ["DecodeEngine", "GenerationParams", "KVCache"]
+__all__ = ["DecodeEngine", "GenerationParams", "KVCache", "Prefix"]

@@ -26,7 +26,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from functools import partial
-from typing import TYPE_CHECKING
+from typing import TYPE_CHECKING, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +40,26 @@ if TYPE_CHECKING:  # a runtime import would be circular when the models
     # package is imported first (models.decoder -> engine.cache runs
     # engine/__init__ -> engine.engine -> models.decoder).
     from llmss_tpu.models.decoder import Params  # noqa: F401
+
+
+class Prefix(NamedTuple):
+    """A retained, device-resident KV segment for a shared prompt prefix
+    (system prompt / earlier turns of a session). Built once with
+    ``DecodeEngine.build_prefix``; admissions that start with these tokens
+    seed their cache rows from it and prefill only the suffix — the
+    prefix's prefill FLOPs and TTFT are paid once per prefix, not per
+    request. The reference has no analogue (it re-prefills every request
+    from scratch, ``generate.py:99``)."""
+
+    tokens: tuple[int, ...]  # the prefix token ids (host, for matching)
+    k: jax.Array  # [L, P, Hkv, D] (or int8 when the engine is int8)
+    v: jax.Array
+    k_scale: jax.Array | None  # [L, P, Hkv] f32 iff int8
+    v_scale: jax.Array | None
+
+    @property
+    def length(self) -> int:
+        return len(self.tokens)
 
 
 @dataclasses.dataclass
@@ -124,6 +144,7 @@ class DecodeEngine:
         else:
             self._cache_dtype = cfg.compute_dtype
         self.metrics = EngineMetrics()
+        self._ladder = self.bucket_ladder()
 
         # mesh is partial-bound (a compile-time constant, not a traced arg):
         # it enables the shard_map'd Pallas attention path inside forward.
@@ -132,41 +153,136 @@ class DecodeEngine:
         )
         self._decode = jax.jit(
             partial(self._decode_impl, cfg, mesh), donate_argnums=(2,),
+            static_argnames=("t_bucket",),
         )
         self._decode_many = jax.jit(
             partial(self._decode_many_impl, cfg, mesh),
             donate_argnums=(2,),
-            static_argnames=("n_steps",),
+            static_argnames=("n_steps", "t_bucket"),
         )
         self._admit_merge = jax.jit(
             self._admit_merge_impl, donate_argnums=(0, 1)
         )
+        self._seed = jax.jit(self._seed_impl, donate_argnums=(0,))
 
     # -- jitted bodies ------------------------------------------------------
 
     @staticmethod
-    def _prefill_impl(cfg, mesh, params, ids, cache, prompt_lens, sample_args):
+    def _prefill_impl(
+        cfg, mesh, params, ids, cache, prompt_lens, sample_args, start=None,
+    ):
+        """Prefill ``ids`` into the cache. ``start`` ([B] int32, optional)
+        offsets every row's positions — the prefix-reuse path prefills only
+        a request's *suffix* at positions ``[start, start + len)`` against
+        a cache whose first ``start`` slots were seeded from a retained
+        ``Prefix``; ``prompt_lens`` is then the suffix length. The default
+        (start absent) is the ordinary from-zero prefill."""
         from llmss_tpu.models.decoder import forward
 
         B, S = ids.shape
-        positions = jnp.broadcast_to(
-            jnp.arange(S, dtype=jnp.int32), (B, S)
-        )
-        valid = positions < prompt_lens[:, None]
+        rel = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        off = jnp.zeros((B,), jnp.int32) if start is None else start
+        positions = off[:, None] + rel
+        valid = rel < prompt_lens[:, None]
         slots = positions % cache.max_len
         kv_pos = jnp.where(valid, positions, -1)
         logits, cache = forward(
             cfg, params, ids, positions, cache, slots,
             gather_idx=prompt_lens - 1, kv_write_positions=kv_pos, mesh=mesh,
         )
-        # The sampled token sits at position prompt_len — that position is
-        # the per-row draw counter (ops/sampling.py: stateless per-request
-        # randomness).
-        tok = sample(logits[:, 0], counters=prompt_lens, **sample_args)
+        # The sampled token sits at absolute position start + prompt_len —
+        # that position is the per-row draw counter (ops/sampling.py:
+        # stateless per-request randomness), so a prefix-reused request
+        # draws exactly the tokens it would draw prefilled from scratch.
+        tok = sample(logits[:, 0], counters=off + prompt_lens, **sample_args)
         return tok, logits[:, 0], cache
 
     @staticmethod
-    def _decode_impl(cfg, mesh, params, tokens, cache, cur_pos, sample_args):
+    def _seed_impl(cache: KVCache, pk, pv, pks, pvs):
+        """Write a retained prefix segment into slots [0, P) of EVERY row
+        of a (fresh) cache, recording positions 0..P-1. Rows that go on to
+        serve non-prefix work are simply overwritten by their own prefill;
+        dummy admission rows ignore it entirely."""
+        P = pk.shape[1]
+        pos = cache.positions.at[:, :P].set(
+            jnp.arange(P, dtype=jnp.int32)[None, :]
+        )
+        return KVCache(
+            k=cache.k.at[:, :, :P].set(pk[:, None]),
+            v=cache.v.at[:, :, :P].set(pv[:, None]),
+            positions=pos,
+            k_scale=(
+                cache.k_scale.at[:, :, :P].set(pks[:, None])
+                if pks is not None else None
+            ),
+            v_scale=(
+                cache.v_scale.at[:, :, :P].set(pvs[:, None])
+                if pvs is not None else None
+            ),
+        )
+
+    def seed_cache(self, cache: KVCache, prefix: Prefix) -> KVCache:
+        """Seed a fresh cache's rows with ``prefix`` (jitted, donating)."""
+        return self._seed(
+            cache, prefix.k, prefix.v, prefix.k_scale, prefix.v_scale
+        )
+
+    def build_prefix(self, token_ids: list[int]) -> Prefix:
+        """Prefill ``token_ids`` once and retain the resulting KV segment
+        for reuse by later requests that start with these tokens (shared
+        system prompt, earlier turns of a session). int8 engines store the
+        prefix quantized — the seeded bits are identical on every reuse
+        (storage bit-stability, models/decoder.py)."""
+        P = len(token_ids)
+        if not 0 < P < self.max_seq_len:
+            raise ValueError(
+                f"prefix length {P} must be in (0, {self.max_seq_len})"
+            )
+        cache = self.new_cache(1)
+        ids, lens = self._pad_prompts([list(token_ids)])
+        sa = self._sample_args(GenerationParams(), 1)
+        _, _, cache = self._prefill(
+            self.params, jnp.asarray(ids), cache, jnp.asarray(lens), sa,
+        )
+        return Prefix(
+            tokens=tuple(int(t) for t in token_ids),
+            k=cache.k[:, 0, :P],
+            v=cache.v[:, 0, :P],
+            k_scale=(
+                cache.k_scale[:, 0, :P] if cache.k_scale is not None
+                else None
+            ),
+            v_scale=(
+                cache.v_scale[:, 0, :P] if cache.v_scale is not None
+                else None
+            ),
+        )
+
+    @staticmethod
+    def split_prefix(
+        prompts: list[list[int]], prefix: Prefix
+    ) -> tuple[np.ndarray, list[list[int]]]:
+        """Validate every prompt extends ``prefix`` and return (full
+        lengths, suffixes). A prompt must be strictly longer than the
+        prefix — the suffix prefill needs at least one token to produce
+        the first logits."""
+        P = prefix.length
+        suffixes = []
+        for p in prompts:
+            if len(p) <= P or tuple(p[:P]) != prefix.tokens:
+                raise ValueError(
+                    "prompt does not extend the prefix (needs the prefix's "
+                    f"{P} tokens plus at least one more)"
+                )
+            suffixes.append(list(p[P:]))
+        lens = np.asarray([len(p) for p in prompts], np.int32)
+        return lens, suffixes
+
+    @staticmethod
+    def _decode_impl(
+        cfg, mesh, params, tokens, cache, cur_pos, sample_args,
+        *, t_bucket: int | None = None,
+    ):
         from llmss_tpu.models.decoder import forward
 
         # tokens [B], cur_pos [B] — position at which each token sits.
@@ -174,7 +290,7 @@ class DecodeEngine:
         slots = positions % cache.max_len
         logits, cache = forward(
             cfg, params, tokens[:, None], positions, cache, slots,
-            last_only=True, mesh=mesh,
+            last_only=True, mesh=mesh, t_bucket=t_bucket,
         )
         tok = sample(logits[:, 0], counters=cur_pos + 1, **sample_args)
         return tok, logits[:, 0], cache
@@ -196,7 +312,7 @@ class DecodeEngine:
     @staticmethod
     def _decode_many_impl(
         cfg, mesh, params, tokens, cache, cur_pos, sample_args, done,
-        eos, *, n_steps: int,
+        eos, *, n_steps: int, t_bucket: int | None = None,
     ):
         """Fused multi-token decode: lax.scan over the single-token step."""
         from llmss_tpu.models.decoder import forward
@@ -207,7 +323,7 @@ class DecodeEngine:
             slots = positions % cache.max_len
             logits, cache = forward(
                 cfg, params, tokens[:, None], positions, cache, slots,
-                last_only=True, mesh=mesh,
+                last_only=True, mesh=mesh, t_bucket=t_bucket,
             )
             tok = sample(logits[:, 0], counters=cur_pos + 1, **sample_args)
             tok = jnp.where(done, eos, tok)
@@ -244,15 +360,90 @@ class DecodeEngine:
         out.append(self.max_seq_len)
         return out
 
+    def bucket_ladder(self) -> list[int]:
+        """The static cache-read buckets decode executables compile for:
+        multiples of ``max(32, max_seq_len/16)`` below max_seq_len — at
+        most 15 entries, so the executable set stays bounded while the
+        average over-read is ~one granule. ``LLMSS_BUCKETS=0`` disables
+        bucketing (every decode reads the full ring)."""
+        import os
+
+        if os.environ.get("LLMSS_BUCKETS") == "0":
+            return []
+        g = max(32, -(-self.max_seq_len // (16 * 32)) * 32)  # round UP
+        return list(range(g, self.max_seq_len, g))
+
+    def decode_bucket(self, pos_bound: int) -> int | None:
+        """Pick the cache-read bucket for a decode call whose rows' ring
+        positions (current + steps in the call) are all < ``pos_bound``.
+        Returns None — read the full ring — when no ladder entry covers it,
+        when any row may have wrapped (pos_bound > max_seq_len), or on the
+        sp>1 / Pallas-kernel decode paths (which read the full cache by
+        construction)."""
+        import importlib
+
+        from llmss_tpu.parallel.mesh import AXIS_SP
+
+        _att = importlib.import_module("llmss_tpu.ops.attention")
+        if self.mesh is not None and AXIS_SP in self.mesh.shape and (
+            self.mesh.shape[AXIS_SP] > 1
+        ):
+            return None
+        if _att.IMPL_OVERRIDE == "pallas":
+            return None
+        if pos_bound > self.max_seq_len:
+            return None  # wrapped rows: full-ring semantics
+        for b in self._ladder:
+            if b >= pos_bound:
+                return b
+        return None
+
+    def prewarm_bucket_set(self) -> "list[int | None]":
+        """Every ``t_bucket`` value the live decode path can pick — what a
+        prewarm must compile. Skips the ladder when this engine's decode
+        path can't bucket at all (sp>1 mesh / pallas override): every
+        ladder value would compile a byte-identical full-ring program."""
+        out: list[int | None] = [None]
+        if self.decode_bucket(1) is not None:
+            out += self._ladder
+        return out
+
+    def check_capacity(self, n_prompt_tokens: int, max_new_tokens: int):
+        """Reject a request that cannot fit the ring: it would advance past
+        max_seq_len mid-generation, wrap, and silently slide its own early
+        context out of the window. The ONE capacity rule shared by every
+        serving path (batch Worker and continuous batcher)."""
+        if n_prompt_tokens + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({n_prompt_tokens} tokens) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the engine's max_seq_len "
+                f"({self.max_seq_len})"
+            )
+
     def prewarm(
         self, batch: int, *, chunk_steps: tuple[int, ...] | int = (),
+        buckets: bool = True, prefix_prefill: bool = False,
     ) -> int:
         """Compile every executable the serving path can hit at ``batch``:
         prefill for each seq bucket, the single-token decode step, and the
-        fused chunk scans. Eats the multi-second XLA compiles at worker
-        startup instead of on the first unlucky request
-        (engine/engine.py's bucketing bounds the set to log₂ buckets).
-        Returns the number of executables compiled."""
+        fused chunk scans — each × every cache-read bucket when ``buckets``
+        (the default; the live path picks buckets by row position, so all
+        are reachable). Eats the multi-second XLA compiles at worker
+        startup instead of on the first unlucky request. Returns the number
+        of executables compiled.
+
+        Each executable is compiled exactly ONCE: ``generate``/
+        ``generate_fused`` (like the scheduler) re-wrap every carried state
+        array with the engine's canonical shardings, so each executable has
+        a single steady-state input signature.
+
+        ``prefix_prefill`` additionally compiles each prefill bucket's
+        prefix-reuse variant (the ``start``-offset signature): set it when
+        this engine will serve ``generate(prefix=...)`` so the first
+        prefix request doesn't eat the multi-second prefill compile
+        mid-serve. (The per-prefix seed scatter still compiles on first
+        use — its shape depends on the prefix length — but that's a
+        sub-second scatter compile, not a model compile.)"""
         if isinstance(chunk_steps, int):
             chunk_steps = (chunk_steps,)
         sa = self._sample_args(GenerationParams(), batch)
@@ -264,26 +455,35 @@ class DecodeEngine:
             tok, _, cache = self._prefill(self.params, ids, cache, lens, sa)
             del cache
             n += 1
-        cache = self.new_cache(batch)
-        cur = jnp.ones(batch, jnp.int32)
-        # Each cache-consuming executable is called twice: the cache's
-        # PartitionSpec representation alternates between two normalized
-        # forms as it cycles through jit outputs (trailing-None stripped /
-        # re-added), giving every such executable two steady-state input
-        # signatures — both must be compiled or the second real call still
-        # stalls.
-        for _ in range(2):
-            tok, _, cache = self._decode(self.params, tok, cache, cur, sa)
+            if prefix_prefill:
+                cache = self.new_cache(batch)
+                tok, _, cache = self._prefill(
+                    self.params, ids, cache, lens, sa,
+                    jnp.zeros(batch, jnp.int32),
+                )
+                del cache
+                n += 1
+        tok = self.canon_vec(tok)
+        bucket_set = self.prewarm_bucket_set() if buckets else [None]
+        cache = self.canon_cache(self.new_cache(batch))
+        cur = self.canon_vec(jnp.ones(batch, jnp.int32))
+        for tb in bucket_set:
+            _, _, c2 = self._decode(
+                self.params, tok, cache, cur, sa, t_bucket=tb
+            )
+            cache = self.canon_cache(c2)
             n += 1
         for k in chunk_steps:
             if k <= 1:
                 continue
-            done = jnp.zeros(batch, bool)
-            for _ in range(2):
-                _, cache, _, _ = self._decode_many(
-                    self.params, tok, cache, cur, sa, done,
-                    jnp.full(batch, -1, jnp.int32), n_steps=k,
+            done = self.canon_vec(jnp.zeros(batch, bool))
+            eos = self.canon_vec(jnp.full(batch, -1, jnp.int32))
+            for tb in bucket_set:
+                _, c2, _, _ = self._decode_many(
+                    self.params, tok, cache, cur, sa, done, eos,
+                    n_steps=k, t_bucket=tb,
                 )
+                cache = self.canon_cache(c2)
                 n += 1
         del cache
         return n
@@ -381,8 +581,15 @@ class DecodeEngine:
         cancel_poll=None,
         chunk_steps: int = 1,
         live_rows: int | None = None,
+        prefix: "Prefix | None" = None,
     ) -> list[list[int]]:
         """Streaming host-loop generation (≙ generate.py:99-145 cache path).
+
+        ``prefix``: a retained KV segment (``build_prefix``) every prompt
+        must extend — its tokens are NOT re-prefilled: the cache rows are
+        seeded from the segment and only each prompt's suffix runs through
+        the model. Emitted tokens are identical to the from-scratch run
+        (positions, masks, and sampling counters are all absolute).
 
         ``gen`` may be a list with one entry per prompt: a batch can mix
         greedy/sampled requests with different warpers, lengths, and EOS ids
@@ -417,14 +624,31 @@ class DecodeEngine:
         assert len(gens) == B
         for g in gens:
             g.validate()
-        ids, lens = self._pad_prompts(prompts)
         cache = self.new_cache(B)
         sample_args = self._sample_args(gens, B)
 
-        tok, _, cache = self.timed_prefill(
-            self._prefill, self.params, jnp.asarray(ids), cache,
-            jnp.asarray(lens), sample_args, batch=live_rows or B,
-        )
+        if prefix is not None:
+            full_lens, suffixes = self.split_prefix(prompts, prefix)
+            ids, suf_lens = self._pad_prompts(suffixes)
+            cache = self.canon_cache(self.seed_cache(cache, prefix))
+            start = jnp.full(B, prefix.length, jnp.int32)
+            tok, _, cache = self.timed_prefill(
+                self._prefill, self.params, jnp.asarray(ids), cache,
+                jnp.asarray(suf_lens), sample_args, start,
+                batch=live_rows or B,
+            )
+            lens = full_lens
+        else:
+            ids, lens = self._pad_prompts(prompts)
+            tok, _, cache = self.timed_prefill(
+                self._prefill, self.params, jnp.asarray(ids), cache,
+                jnp.asarray(lens), sample_args, batch=live_rows or B,
+            )
+        # Carry canon-resharded state (like the scheduler): every decode
+        # executable then has exactly one steady-state input signature, so
+        # prewarm compiles each once and no mid-request compile can occur.
+        tok = self.canon_vec(tok)
+        cache = self.canon_cache(cache)
         eos = np.asarray(
             [g.eos_token_id if g.eos_token_id is not None else -1
              for g in gens]
@@ -432,9 +656,13 @@ class DecodeEngine:
         max_new = np.asarray([g.max_new_tokens for g in gens])
         out = [[] for _ in range(B)]
         done = np.zeros(B, bool)
-        cur_pos = jnp.asarray(lens)
+        cur_pos = self.canon_vec(jnp.asarray(lens))
+        # Host-side upper bound on any row's ring position — drives the
+        # cache-read bucket (decode cost follows live context, not ring
+        # size).
+        pos_hi = int(lens.max())
         total_steps = int(max_new.max())
-        eos_dev = jnp.asarray(eos, jnp.int32)
+        eos_dev = self.canon_vec(jnp.asarray(eos, jnp.int32))
 
         step = 0
 
@@ -484,27 +712,35 @@ class DecodeEngine:
             if k == 1:
                 with self.metrics.decode_step.time():
                     tok, _, cache = self._decode(
-                        self.params, tok, cache, cur_pos, sample_args
+                        self.params, tok, cache, cur_pos, sample_args,
+                        t_bucket=self.decode_bucket(pos_hi + 1),
                     )
                     # Sync inside the timer: dispatch is async, so without
                     # this the stat would record ~µs dispatch overhead, not
                     # step latency. The loop reads the token next iteration
                     # anyway, so this costs nothing.
                     tok.block_until_ready()
+                tok = self.canon_vec(tok)
+                cache = self.canon_cache(cache)
                 cur_pos = cur_pos + 1
+                pos_hi += 1
                 process(np.asarray(tok))
                 flush_increments()
             else:
                 t0 = time.perf_counter()
                 toks, cache, cur_pos, _ = self._decode_many(
                     self.params, tok, cache, cur_pos, sample_args,
-                    jnp.asarray(done), eos_dev, n_steps=k,
+                    self.canon_vec(jnp.asarray(done)), eos_dev, n_steps=k,
+                    t_bucket=self.decode_bucket(pos_hi + k),
                 )
+                cache = self.canon_cache(cache)
+                cur_pos = self.canon_vec(cur_pos)
+                pos_hi += k
                 chunk_np = np.asarray(toks)  # [B, k] — the real host sync
                 self.metrics.decode_step.record(
                     (time.perf_counter() - t0) / k
                 )
-                tok = toks[:, -1]
+                tok = self.canon_vec(toks[:, -1])
                 for col in range(k):
                     if process(chunk_np[:, col]):
                         break
@@ -532,13 +768,19 @@ class DecodeEngine:
             self._prefill, self.params, jnp.asarray(ids), cache,
             jnp.asarray(lens), sample_args, batch=B,
         )
+        tok = self.canon_vec(tok)
+        cache = self.canon_cache(cache)
         eos = jnp.int32(
             gen.eos_token_id if gen.eos_token_id is not None else -1
         )
-        done = tok == eos
+        eos_dev = self.canon_vec(jnp.full(B, int(eos), jnp.int32))
+        done = self.canon_vec(tok == eos_dev)
         toks, cache, _, done = self._decode_many(
-            self.params, tok, cache, jnp.asarray(lens), sample_args,
-            done, eos, n_steps=gen.max_new_tokens - 1,
+            self.params, tok, cache, self.canon_vec(jnp.asarray(lens)),
+            sample_args, done, eos_dev, n_steps=gen.max_new_tokens - 1,
+            t_bucket=self.decode_bucket(
+                int(lens.max()) + gen.max_new_tokens - 1
+            ),
         )
         first = np.asarray(tok)[:, None]
         rest = np.asarray(toks)

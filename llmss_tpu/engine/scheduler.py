@@ -124,6 +124,14 @@ class ContinuousBatcher:
         self.pending: deque = deque()
         self.active: dict[int, _Row] = {}
         self._free = list(range(rows))
+        # Host-side upper bound on each ACTIVE row's ring position — drives
+        # the decode chunk's cache-read bucket (engine.decode_bucket): the
+        # chunk reads only the live-context prefix of the ring, so decode
+        # cost follows occupancy, not the provisioned max_seq_len. Freed
+        # rows keep advancing on device past any bucket; their reads are
+        # garbage nobody consumes and their writes stay within their own
+        # row, so only active rows constrain the bucket.
+        self._row_pos: dict[int, int] = {}
         # Device-resident decode state (see module docstring), carried in
         # the engine's canonical shardings so every executable keeps one
         # steady-state signature (DecodeEngine.canon_cache/canon_vec).
@@ -174,14 +182,20 @@ class ContinuousBatcher:
             ),
         )
 
-    def prewarm(self, seq_buckets: list[int] | None = None) -> int:
+    def prewarm(
+        self, seq_buckets: list[int] | None = None,
+        prefix_prefill: bool = False,
+    ) -> int:
         """Compile every executable the scheduler can hit: admission
         prefill for each (admission-batch P, seq bucket S) pair, the row
         insert + device-state merge per P, and the decode chunk at the
         full row count — so no request ever eats a multi-second XLA
         compile mid-serve. ``seq_buckets`` narrows the prompt-length
         envelope when known (default: every bucket up to the engine's
-        max_seq_len). Returns the number of executables compiled."""
+        max_seq_len); ``prefix_prefill`` additionally compiles each
+        bucket's prefix-reuse admission variant (the ``start``-offset
+        signature) — set it when requests will carry a ``prefix``.
+        Returns the number of executables compiled."""
         eng = self.engine
         if seq_buckets is None:
             seq_buckets = eng.seq_buckets()
@@ -203,6 +217,13 @@ class ContinuousBatcher:
                     eng.params, ids, scratch, jnp.asarray(lens), sa,
                 )
                 n_compiled += 1
+                if prefix_prefill:
+                    scratch = eng.new_cache(P)
+                    tok, _, scratch = self._prefill_row(
+                        eng.params, ids, scratch, jnp.asarray(lens), sa,
+                        jnp.zeros(P, np.int32),
+                    )
+                    n_compiled += 1
             # Insert with all-dropped indices: compiles the P-shaped
             # scatter without touching live rows. Once — the live path
             # feeds it exactly these canonical shardings.
@@ -220,20 +241,23 @@ class ContinuousBatcher:
                 )
             )
             n_compiled += 1
-        # Decode chunk at the full row count, both chunk sizes.
+        # Decode chunk at the full row count: both chunk sizes × every
+        # cache-read bucket (the live path picks the bucket from row
+        # positions, so all ladder entries are reachable).
         sa = eng._sample_args(GenerationParams(), self.rows)
         for k in sorted({self.chunk_steps, self.chunk_steps_low}):
-            toks, cache, cur_pos, _ = eng._decode_many(
-                eng.params, self._tokens_dev, self.cache,
-                self._cur_pos_dev, sa,
-                jnp.ones(self.rows, bool),
-                jnp.full(self.rows, -1, np.int32),
-                n_steps=k,
-            )
-            self.cache = eng.canon_cache(cache)
-            self._cur_pos_dev = eng.canon_vec(cur_pos)
-            self._tokens_dev = eng.canon_vec(toks[:, -1])
-            n_compiled += 1
+            for tb in eng.prewarm_bucket_set():
+                toks, cache, cur_pos, _ = eng._decode_many(
+                    eng.params, self._tokens_dev, self.cache,
+                    self._cur_pos_dev, sa,
+                    jnp.ones(self.rows, bool),
+                    jnp.full(self.rows, -1, np.int32),
+                    n_steps=k, t_bucket=tb,
+                )
+                self.cache = eng.canon_cache(cache)
+                self._cur_pos_dev = eng.canon_vec(cur_pos)
+                self._tokens_dev = eng.canon_vec(toks[:, -1])
+                n_compiled += 1
         # The prewarm decode ran with every row marked done/free, but its
         # cache writes still landed — reset positions so no ghost slots
         # survive into real serving. device_put with the original sharding:
@@ -258,12 +282,32 @@ class ContinuousBatcher:
         done_cb: Callable[[list[int]], None],
         req_id: str = "",
         stream_cb: Callable[[list[int]], None] | None = None,
+        prefix=None,  # engine.Prefix: token_ids must extend it
     ) -> None:
+        """Queue a request. ``prefix`` (from ``engine.build_prefix``) marks
+        ``token_ids`` as extending a retained KV segment: admission seeds
+        the row from the segment and prefills only the suffix — turn-2 of
+        a session (or the Nth request sharing a system prompt) skips the
+        shared prefill entirely, with identical tokens."""
         gen.validate()
+        if prefix is not None:
+            # Same contract split_prefix enforces; checked at submit time
+            # so the error surfaces on the caller, not the worker thread.
+            P = prefix.length
+            if len(token_ids) <= P or tuple(token_ids[:P]) != prefix.tokens:
+                raise ValueError(
+                    "token_ids does not extend the prefix (needs its "
+                    f"{P} tokens plus at least one more)"
+                )
+        # With chunked decode a near-capacity row would advance past
+        # max_seq_len mid-chunk, wrap, and silently serve context-corrupted
+        # tokens (the host can't see the wrap — the decode state is
+        # device-resident).
+        self.engine.check_capacity(len(token_ids), gen.max_new_tokens)
         with self._lock:
             self.pending.append(
                 (req_id, list(token_ids), gen, done_cb, stream_cb,
-                 time.perf_counter())
+                 time.perf_counter(), prefix)
             )
 
     # -- scheduling ---------------------------------------------------------
@@ -283,37 +327,69 @@ class ContinuousBatcher:
 
         The admission batch pads to a power of two (dummy rows) so the
         compile envelope stays (log₂ rows × log₂ seq buckets) executables.
+
+        Prefix-sharing requests are admitted in their own batches (every
+        row of one admission shares one retained ``Prefix``, matched by
+        identity): the scratch cache is seeded from the segment and only
+        the suffixes prefill. One admission takes the OLDEST request's
+        whole group from anywhere in the queue (same-prefix entries may
+        jump ahead of other groups by one admission — the other groups go
+        in the next step's admission, one chunk later), so an interleaved
+        queue still admits in O(#groups) steps, not O(#requests).
         """
         with self._lock:
-            n = min(len(self.pending), len(self._free))
-            if n == 0:
+            if not self.pending or not self._free:
                 return None
-            taken = [self.pending.popleft() for _ in range(n)]
-            rows = [self._free.pop() for _ in range(n)]
+            head_prefix = self.pending[0][6]
+            free_n = len(self._free)
+            taken, rest = [], deque()
+            while self.pending:
+                item = self.pending.popleft()
+                if len(taken) < free_n and item[6] is head_prefix:
+                    taken.append(item)
+                else:
+                    rest.append(item)
+            self.pending = rest
+            rows = [self._free.pop() for _ in taken]
+            n = len(taken)
 
         P = 1
         while P < n:
             P *= 2
+        plen = head_prefix.length if head_prefix is not None else 0
+        # With a prefix, only each request's suffix is padded/prefilled.
+        suffixes = [
+            ids[plen:] for _rid, ids, _g, _cb, _scb, _t, _p in taken
+        ]
         S = _bucket(
-            max(len(ids) for _rid, ids, _g, _cb, _scb, _t in taken),
-            self.engine.max_seq_len,
+            max(len(s) for s in suffixes), self.engine.max_seq_len,
         )
         padded = np.zeros((P, S), np.int32)
         lens = np.ones(P, np.int32)  # dummy rows prefill one pad token
         gens = []
-        for i, (_rid, ids, gen, _cb, _scb, _t) in enumerate(taken):
-            padded[i, : len(ids)] = ids
-            lens[i] = len(ids)
-            gens.append(gen)
+        for i, s in enumerate(suffixes):
+            padded[i, : len(s)] = s
+            lens[i] = len(s)
+            gens.append(taken[i][2])
         gens += [GenerationParams()] * (P - n)
         row_idx = self._pad_row_idx(P, rows)
 
         scratch = self.engine.new_cache(P)
         sample_args = self.engine._sample_args(gens, P)
-        tok, _, scratch = self._prefill_row(
-            self.engine.params, jnp.asarray(padded), scratch,
-            jnp.asarray(lens), sample_args,
-        )
+        if head_prefix is not None:
+            scratch = self.engine.canon_cache(
+                self.engine.seed_cache(scratch, head_prefix)
+            )
+            tok, _, scratch = self._prefill_row(
+                self.engine.params, jnp.asarray(padded), scratch,
+                jnp.asarray(lens), sample_args,
+                jnp.full(P, plen, jnp.int32),
+            )
+        else:
+            tok, _, scratch = self._prefill_row(
+                self.engine.params, jnp.asarray(padded), scratch,
+                jnp.asarray(lens), sample_args,
+            )
         scratch = self.engine.canon_cache(scratch)
         self.cache = self.engine.canon_cache(self._insert(
             self.cache, scratch, jnp.asarray(row_idx)
@@ -322,7 +398,7 @@ class ContinuousBatcher:
             self.engine.canon_vec(x) for x in self.engine._admit_merge(
                 self._tokens_dev, self._cur_pos_dev,
                 self.engine.canon_vec(tok),
-                jnp.asarray(lens), jnp.asarray(row_idx),
+                jnp.asarray(lens + plen), jnp.asarray(row_idx),
             )
         )
         try:
@@ -331,12 +407,15 @@ class ContinuousBatcher:
             pass
 
         entries = []
-        for i, (req_id, ids, gen, cb, scb, t_submit) in enumerate(taken):
+        for i, (req_id, ids, gen, cb, scb, t_submit, _pfx) in enumerate(
+            taken
+        ):
             r = _Row(
                 req_id=req_id, gen=gen, out=[], done_cb=cb, stream_cb=scb,
                 awaiting_first=True, t_submit=t_submit,
             )
             self.active[rows[i]] = r
+            self._row_pos[rows[i]] = len(ids)
             entries.append((rows[i], r))
         return _InFlightAdmission(entries=entries, tok=tok)
 
@@ -378,6 +457,7 @@ class ContinuousBatcher:
 
     def _finish(self, row: int, r: _Row, cancelled: bool = False) -> None:
         self.active.pop(row, None)
+        self._row_pos.pop(row, None)
         with self._lock:
             self._free.append(row)
         self._flush_stream(r)
@@ -416,7 +496,7 @@ class ContinuousBatcher:
             dropped = [p for p in self.pending if p[0] in ids]
             self.pending = deque(p for p in self.pending if p[0] not in ids)
         n = len(dropped)
-        for _rid, _ids, _gen, cb, _scb, _t in dropped:
+        for _rid, _ids, _gen, cb, _scb, _t, _pfx in dropped:
             cb([], True)
         for row, r in list(self.active.items()):
             if r.req_id in ids:
@@ -450,6 +530,7 @@ class ContinuousBatcher:
         self._inflight = None
         self._pending_adm = None
         self._last_fetch_t = None
+        self._row_pos.clear()
         for row in list(self.active):
             r = self.active.pop(row)
             ids.append(r.req_id)
@@ -558,11 +639,16 @@ class ContinuousBatcher:
         done, eos_arr, sa = self._chunk_args()
         busy = len(self.active) >= (3 * self.rows) // 4
         k = self.chunk_steps if busy else self.chunk_steps_low
+        t_bucket = self.engine.decode_bucket(
+            max(self._row_pos.values(), default=0) + k
+        )
         toks, cache, cur_pos, _ = self.engine._decode_many(
             self.engine.params, self._tokens_dev, self.cache,
             self._cur_pos_dev, sa, jnp.asarray(done), jnp.asarray(eos_arr),
-            n_steps=k,
+            n_steps=k, t_bucket=t_bucket,
         )
+        for row in self._row_pos:
+            self._row_pos[row] += k
         self.cache = self.engine.canon_cache(cache)
         self._cur_pos_dev = self.engine.canon_vec(cur_pos)
         self._tokens_dev = self.engine.canon_vec(toks[:, -1])

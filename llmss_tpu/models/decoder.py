@@ -457,6 +457,7 @@ def forward(
     gather_idx: jax.Array | None = None,  # [B] per-row index into S
     kv_write_positions: jax.Array | None = None,  # [B, S]; -1 marks padding
     mesh=None,  # enables the Pallas attention path (shard_map needs a Mesh)
+    t_bucket: int | None = None,  # static; decode reads only slots [0, t_bucket)
     _ablate: str | None = None,  # profiling-only component removal
 ) -> tuple[jax.Array, KVCache]:
     """Run the decoder; returns (logits fp32, updated cache).
@@ -469,6 +470,17 @@ def forward(
     slots be recorded as −1 (invalid) so later steps never attend them —
     unlike the reference, whose pads participate in attention unmasked
     (``generate.py:104,150`` — SURVEY.md §2.11.3, a quirk fixed here).
+
+    ``t_bucket`` (static) bounds the decode attention's cache read to ring
+    slots ``[0, t_bucket)``: KV-read HBM traffic scales with *live* context,
+    not the provisioned ring size (the decode step is bandwidth-bound, so a
+    quarter-full cache decodes measurably faster — PROFILE.md). Writes still
+    land in the full buffer. **Caller contract** (DecodeEngine.decode_bucket
+    enforces it): every live slot (position >= 0) of every row, and every
+    slot written this call, is < ``t_bucket`` — i.e. no row has ring-wrapped
+    and none will pass position ``t_bucket`` this call. Violations silently
+    drop context. Applied only on the deferred-write decode path (S == 1,
+    sp == 1, XLA attention); other paths ignore it.
     """
     dtype = cfg.compute_dtype
 
@@ -544,32 +556,73 @@ def forward(
                  jnp.arange(cfg.n_layers, dtype=jnp.int32)),
             )
         else:
+            # Bucketed cache read: in bucket mode the per-layer KV (and
+            # scales) is fetched with a hand-emitted ``lax.dynamic_slice``
+            # of size [1, B, t_bucket, Hkv, D] from the full stacked cache
+            # (a scan *constant*, not an xs operand) — only live-context
+            # bytes ever stream from HBM. This slicing must be explicit:
+            # XLA does NOT fold a static T-slice into the scan's
+            # per-iteration layer dynamic-slice — a pre-scan slice of the
+            # stacked cache materializes a fresh [L, B, tb, H, D] operand
+            # (+1.3 ms/step at bench scale) and an in-body slice adds an
+            # HBM round-trip after the full-T copy (+0.3 ms/step); both
+            # measured slower than just reading the full ring. The
+            # post-scan scatter below still writes the full buffers.
+            bucket = (
+                t_bucket
+                if t_bucket is not None and t_bucket < cache.max_len
+                and sp_attn is None
+                else None
+            )
+            kv_pos_src = (
+                cache.positions[:, :bucket]
+                if bucket is not None else cache.positions
+            )
             penalty = None
             if sp_attn is None:
                 penalty = decode_mask_penalty(
-                    positions, cache.positions, slots, cfg.sliding_window
+                    positions, kv_pos_src, slots, cfg.sliding_window
+                )
+            B = input_ids.shape[0]
+            Hkv, D = cfg.n_kv_heads, cfg.head_dim
+
+            def layer_kv(l):
+                """[B, bucket, ...] KV (+scale) slices of layer ``l``."""
+                def sl(buf, *feat):
+                    return jax.lax.dynamic_slice(
+                        buf, (l,) + (0,) * (2 + len(feat)),
+                        (1, B, bucket) + feat,
+                    )[0]
+
+                k_l = sl(cache.k, Hkv, D)
+                v_l = sl(cache.v, Hkv, D)
+                if not quant:
+                    return k_l, v_l, None, None
+                return k_l, v_l, sl(cache.k_scale, Hkv), sl(
+                    cache.v_scale, Hkv
                 )
 
             def body(h, xs):
                 ks_l = vs_l = None
-                if quant:
-                    bp, k_q, v_q, ks_l, vs_l = xs
-                    if sp_attn is not None:
-                        # The sp shard_map path expects compute-dtype
-                        # chunks: pre-dequantize (materializes a bf16 copy
-                        # of the layer — the price of int8 on sp meshes).
-                        k_l = dequantize_kv(k_q, ks_l, dtype)
-                        v_l = dequantize_kv(v_q, vs_l, dtype)
-                        ks_l = vs_l = None
-                    else:
-                        # Raw int8 slices; the scales fold into the
-                        # attention contractions (fresh_kv_decode_attention)
-                        # so no dequantized copy ever materializes.
-                        k_l, v_l = k_q, v_q
+                if bucket is not None:
+                    bp, l = xs
+                    k_l, v_l, ks_l, vs_l = layer_kv(l)
+                elif quant:
+                    bp, k_l, v_l, ks_l, vs_l = xs
                 else:
                     bp, k_l, v_l = xs
+                if quant and sp_attn is not None:
+                    # The sp shard_map path expects compute-dtype chunks:
+                    # pre-dequantize (materializes a bf16 copy of the
+                    # layer — the price of int8 on sp meshes). Otherwise
+                    # the raw int8 slices ride: the scales fold into the
+                    # attention contractions (fresh_kv_decode_attention)
+                    # so no dequantized copy ever materializes.
+                    k_l = dequantize_kv(k_l, ks_l, dtype)
+                    v_l = dequantize_kv(v_l, vs_l, dtype)
+                    ks_l = vs_l = None
                 h, k_f, v_f = _block(
-                    cfg, bp, h, positions, k_l, v_l, cache.positions, slots,
+                    cfg, bp, h, positions, k_l, v_l, kv_pos_src, slots,
                     None, mesh=mesh, defer_write=True,
                     attn_override=sp_attn, ablate=_ablate,
                     sin_cos=sin_cos, penalty=penalty,
@@ -578,11 +631,16 @@ def forward(
                 ys = None if _ablate == "no_scatter" else (k_f, v_f)
                 return h, ys
 
-            xs = (
-                (params["blocks"], cache.k, cache.v, cache.k_scale,
-                 cache.v_scale)
-                if quant else (params["blocks"], cache.k, cache.v)
-            )
+            if bucket is not None:
+                xs = (
+                    params["blocks"],
+                    jnp.arange(cfg.n_layers, dtype=jnp.int32),
+                )
+            elif quant:
+                xs = (params["blocks"], cache.k, cache.v, cache.k_scale,
+                      cache.v_scale)
+            else:
+                xs = (params["blocks"], cache.k, cache.v)
             h, ys = jax.lax.scan(body, h, xs)
         ks_new, vs_new = cache.k_scale, cache.v_scale
         if _ablate == "no_scatter":
@@ -622,11 +680,12 @@ def forward(
             if quant:
                 # Quantize ONLY the freshly written tokens and scatter them
                 # (values + scales) into the carried int8 cache. Untouched
-                # slots are never dequant→requant round-tripped, so they
-                # are bit-stable by construction — prefix reuse over a
-                # populated int8 cache stays exact. (The dequantized
-                # ``k_l``/``v_l`` above exist only for this layer's
-                # attention read.)
+                # slots are never dequant→requant round-tripped, so their
+                # STORAGE is bit-stable by construction — a reused prefix
+                # holds identical int8 bits. (Reads are not bitwise
+                # identical across paths: this S>1 branch dequantizes in
+                # compute dtype, while the decode path folds the scales in
+                # fp32 — a small, bounded read-side difference.)
                 k8, ks_f = quantize_kv(k_f)  # [B, S, Hkv(, D)]
                 v8, vs_f = quantize_kv(v_f)
                 k_q = k_q.at[b_idx, slots].set(k8)

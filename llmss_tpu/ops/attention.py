@@ -182,6 +182,10 @@ def fresh_kv_decode_attention(
     B, S, Hq, D = q.shape
     T, Hkv = k_cache.shape[1], k_cache.shape[2]
     G = Hq // Hkv
+    # Single-token decode only: the mask penalty is [B, T] (one query row
+    # per batch row); an S > 1 call would broadcast one penalty over all
+    # query positions and silently drop per-position causality.
+    assert S == 1, f"fresh_kv_decode_attention requires S == 1, got S={S}"
     if scale is None:
         scale = 1.0 / (D**0.5)
 

@@ -128,8 +128,12 @@ class Worker:
                 continue
             try:
                 req.validate()
-                prompts.append(self._encode(req))
-                gens.append(self._gen_params(req))
+                ids = self._encode(req)
+                gp = self._gen_params(req)
+                # Same ring-capacity rule as ContinuousBatcher.submit.
+                self.engine.check_capacity(len(ids), gp.max_new_tokens)
+                prompts.append(ids)
+                gens.append(gp)
                 ok.append(req)
             except Exception as e:  # noqa: BLE001 — per-request error surface
                 self.broker.push_response(
@@ -240,10 +244,14 @@ class ContinuousWorker:
         self.poll_timeout_s = poll_timeout_s
         self._publish_counter = 0
 
-    def prewarm(self, seq_buckets: list[int] | None = None) -> int:
+    def prewarm(
+        self, seq_buckets: list[int] | None = None,
+        prefix_prefill: bool = False,
+    ) -> int:
         """Compile the batcher's full executable envelope up front
-        (``seq_buckets`` narrows the prompt-length envelope when known)."""
-        return self.batcher.prewarm(seq_buckets)
+        (``seq_buckets`` narrows the prompt-length envelope when known;
+        ``prefix_prefill`` adds the prefix-reuse admission variants)."""
+        return self.batcher.prewarm(seq_buckets, prefix_prefill)
 
     def _drain_broker(self) -> int:
         n = 0
@@ -290,9 +298,15 @@ class ContinuousWorker:
                 def stream_cb(new_toks, req=req):
                     self.broker.push_stream(req.id, new_toks)
 
-            self.batcher.submit(
-                ids, gen, cb, req_id=req.id, stream_cb=stream_cb
-            )
+            try:
+                self.batcher.submit(
+                    ids, gen, cb, req_id=req.id, stream_cb=stream_cb
+                )
+            except ValueError as e:  # e.g. prompt + max_new exceeds the ring
+                self.broker.push_response(
+                    GenerateResponse(id=req.id, error=str(e))
+                )
+                continue
             n += 1
 
     def run_once(self) -> int:

@@ -1,0 +1,135 @@
+"""Prefix/session KV reuse: seed rows from a retained segment, prefill
+only the suffix, emit IDENTICAL tokens.
+
+The reference re-prefills every request from scratch (``generate.py:99``);
+here a shared system prompt / earlier session turn is prefilled once
+(``DecodeEngine.build_prefix``) and later requests reuse the device-resident
+KV segment — positions, masks, and sampling counters are absolute, so the
+emitted tokens are exactly the from-scratch tokens while the shared
+prefill's FLOPs and latency are skipped.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmss_tpu.engine import DecodeEngine, GenerationParams
+from llmss_tpu.engine.scheduler import ContinuousBatcher
+from llmss_tpu.parallel import MeshPlan, make_mesh
+from tests.test_bucket import _cfg
+
+
+@pytest.fixture(scope="module")
+def setup(devices):
+    mesh = make_mesh(MeshPlan(dp=2, tp=4))
+    cfg = _cfg()
+    params = __import__(
+        "llmss_tpu.models.decoder", fromlist=["init_params"]
+    ).init_params(cfg, mesh, jax.random.key(0))
+    return cfg, params, mesh
+
+
+PREFIX = [7, 3, 19, 42, 5, 11, 30, 2, 9, 17, 28, 33, 21, 6, 13, 40, 8, 25]
+
+
+def test_engine_prefix_identical_tokens_and_skipped_prefill(setup):
+    cfg, params, mesh = setup
+    eng = DecodeEngine(cfg, params, mesh, max_seq_len=64)
+    pfx = eng.build_prefix(PREFIX)
+    prompts = [PREFIX + [50, 51], PREFIX + [60], PREFIX + [1, 2, 3, 4]]
+    for gen in (
+        GenerationParams(max_new_tokens=12, is_greedy=True),
+        GenerationParams(
+            max_new_tokens=12, is_greedy=False, temperature=0.9, top_k=8,
+            seed=4,
+        ),
+    ):
+        scratch_run = eng.generate(prompts, gen, chunk_steps=4)
+
+        calls = []
+        orig = eng._prefill
+
+        def spy(params, ids, cache, lens, sa, *rest):
+            calls.append(ids.shape)
+            return orig(params, ids, cache, lens, sa, *rest)
+
+        eng._prefill = spy
+        try:
+            reused_run = eng.generate(
+                prompts, gen, chunk_steps=4, prefix=pfx
+            )
+        finally:
+            eng._prefill = orig
+        assert reused_run == scratch_run
+        # The suffix prefill padded to the SUFFIX bucket (max suffix 4 ->
+        # bucket 16), not the full-prompt bucket (22 -> 32): the prefix's
+        # 18 tokens never went through the model again.
+        assert calls == [(3, 16)]
+
+
+def test_engine_prefix_validation(setup):
+    cfg, params, mesh = setup
+    eng = DecodeEngine(cfg, params, mesh, max_seq_len=64)
+    pfx = eng.build_prefix(PREFIX)
+    gen = GenerationParams(max_new_tokens=4, is_greedy=True)
+    with pytest.raises(ValueError, match="extend the prefix"):
+        eng.generate([[1, 2, 3]], gen, prefix=pfx)  # wrong tokens
+    with pytest.raises(ValueError, match="extend the prefix"):
+        eng.generate([list(PREFIX)], gen, prefix=pfx)  # no suffix
+    with pytest.raises(ValueError, match="prefix length"):
+        eng.build_prefix([])
+    with pytest.raises(ValueError, match="prefix length"):
+        eng.build_prefix([1] * 64)
+
+
+def test_scheduler_prefix_identical_tokens(setup):
+    """Turn-2-style requests through the continuous batcher, mixed with
+    non-prefix requests in the same queue: prefix rows seed from the
+    retained segment (their own admission batch) and still emit exactly
+    their solo tokens."""
+    cfg, params, mesh = setup
+    eng = DecodeEngine(cfg, params, mesh, max_seq_len=64)
+    pfx = eng.build_prefix(PREFIX)
+    gen = GenerationParams(max_new_tokens=10, is_greedy=True)
+
+    p1 = PREFIX + [50, 51]
+    p2 = PREFIX + [60]
+    plain = [5, 9, 23]
+    solo = eng.generate([p1, p2, plain], gen)
+
+    b = ContinuousBatcher(eng, rows=4, chunk_steps=2)
+    got = {}
+    b.submit(p1, gen, lambda t: got.__setitem__("p1", t), prefix=pfx)
+    b.submit(plain, gen, lambda t: got.__setitem__("plain", t))
+    b.submit(p2, gen, lambda t: got.__setitem__("p2", t), prefix=pfx)
+    b.run_until_idle()
+    assert got["p1"] == solo[0]
+    assert got["p2"] == solo[1]
+    assert got["plain"] == solo[2]
+
+
+def test_scheduler_prefix_submit_validation(setup):
+    cfg, params, mesh = setup
+    eng = DecodeEngine(cfg, params, mesh, max_seq_len=64)
+    pfx = eng.build_prefix(PREFIX)
+    b = ContinuousBatcher(eng, rows=2)
+    with pytest.raises(ValueError, match="extend the prefix"):
+        b.submit(
+            [1, 2], GenerationParams(max_new_tokens=2), lambda t: None,
+            prefix=pfx,
+        )
+
+
+def test_prefix_int8_storage_stable(setup):
+    """int8 engines retain the prefix quantized; seeding writes the same
+    bits every reuse, and generation stays self-consistent."""
+    cfg, params, mesh = setup
+    eng = DecodeEngine(cfg, params, mesh, max_seq_len=64, kv_dtype="int8")
+    pfx = eng.build_prefix(PREFIX)
+    assert pfx.k.dtype == jnp.int8 and pfx.k_scale is not None
+    gen = GenerationParams(max_new_tokens=8, is_greedy=True)
+    prompts = [PREFIX + [50, 51]]
+    a = eng.generate(prompts, gen, prefix=pfx)
+    bb = eng.generate(prompts, gen, prefix=pfx)
+    assert a == bb

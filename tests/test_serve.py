@@ -348,7 +348,7 @@ def test_two_workers_share_one_broker(serving):
     # A long request that will be cancelled mid-flight; either worker may
     # own it.
     broker.push_request(GenerateRequest(
-        id="mw-long", token_ids=[9, 9], max_new_tokens=200, is_greedy=True,
+        id="mw-long", token_ids=[9, 9], max_new_tokens=60, is_greedy=True,
     ))
 
     # Interleave the two workers; cancel the long request once it is
@@ -372,7 +372,7 @@ def test_two_workers_share_one_broker(serving):
     for rid in ids:
         assert got[rid].error is None and len(got[rid].token_ids) == 4
     assert got["mw-long"].error == "cancelled"
-    assert len(got["mw-long"].token_ids or []) < 200
+    assert len(got["mw-long"].token_ids or []) < 60
 
 
 def test_streaming_sse_roundtrip(serving):

@@ -109,7 +109,7 @@ def test_abort_inflight_errors_pending_requests(devices):
     broker = InProcBroker()
     worker = ContinuousWorker(engine, broker, tokenizer=None, rows=2)
     broker.push_request(GenerateRequest(
-        id="rq-long", token_ids=[1, 2, 3], max_new_tokens=500,
+        id="rq-long", token_ids=[1, 2, 3], max_new_tokens=25,
         is_greedy=True,
     ))
     worker.run_once()  # admits the request; far from finished

@@ -1,0 +1,97 @@
+"""Experiment: where does the chained-chunk decode overhead come from?
+
+Times each dispatch of a chained _decode_many sequence (no fetch until the
+end) under three variants:
+- canon   : canon_cache/canon_vec between chunks (serving path)
+- nocanon : raw jit outputs fed straight back in
+- single  : one big fused scan (old bench methodology)
+and with/without bucketed reads.
+"""
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import PROMPT, flagship_cfg  # noqa: E402
+
+from llmss_tpu.engine import DecodeEngine, GenerationParams  # noqa: E402
+from llmss_tpu.models.decoder import init_params  # noqa: E402
+from llmss_tpu.parallel import MeshPlan, make_mesh  # noqa: E402
+
+BATCH = 16
+MAX_SEQ = 448
+CHUNK = 32
+N_CHUNKS = 10
+
+mesh = make_mesh(MeshPlan(tp=len(jax.devices())))
+cfg = flagship_cfg("1b2")
+params = init_params(cfg, mesh, jax.random.key(0))
+engine = DecodeEngine(cfg, params, mesh, max_seq_len=MAX_SEQ)
+gen = GenerationParams(max_new_tokens=8, is_greedy=True)
+rng = np.random.default_rng(0)
+prompts = [rng.integers(0, cfg.vocab_size, PROMPT).tolist() for _ in range(BATCH)]
+ids, lens = engine._pad_prompts(prompts)
+sa = engine._sample_args(gen, BATCH)
+eos = engine.canon_vec(jnp.full(BATCH, -1, jnp.int32))
+done = jnp.zeros(BATCH, bool)
+
+
+def run(variant, use_bucket, timing=False):
+    cache = engine.new_cache(BATCH)
+    tok, _, cache = engine._prefill(
+        engine.params, jnp.asarray(ids), cache, jnp.asarray(lens), sa,
+    )
+    cur = jnp.asarray(lens)
+    if variant == "canon":
+        tok, cache, cur = (
+            engine.canon_vec(tok), engine.canon_cache(cache),
+            engine.canon_vec(cur),
+        )
+    t0 = time.perf_counter()
+    stamps = []
+    if variant == "single":
+        toks, cache, cur, _ = engine._decode_many(
+            engine.params, tok, cache, cur, sa, done, eos,
+            n_steps=CHUNK * N_CHUNKS,
+            t_bucket=None,
+        )
+        total = jnp.sum(toks)
+    else:
+        pos = int(lens.max())
+        total = jnp.zeros((), jnp.int32)
+        for _ in range(N_CHUNKS):
+            tb = engine.decode_bucket(pos + CHUNK) if use_bucket else None
+            toks, cache, cur, _ = engine._decode_many(
+                engine.params, tok, cache, cur, sa, done, eos,
+                n_steps=CHUNK, t_bucket=tb,
+            )
+            if variant == "canon":
+                cache = engine.canon_cache(cache)
+                cur = engine.canon_vec(cur)
+                tok = engine.canon_vec(toks[:, -1])
+            else:
+                tok = toks[:, -1]
+            total = total + jnp.sum(toks)
+            pos += CHUNK
+            stamps.append(time.perf_counter() - t0)
+    _ = int(total)
+    wall = time.perf_counter() - t0
+    if timing:
+        per_step = wall / (CHUNK * N_CHUNKS) * 1e3
+        print(f"{variant:8s} bucket={use_bucket!s:5s} wall={wall*1e3:7.1f}ms "
+              f"per_step={per_step:.3f}ms dispatch_stamps_ms="
+              + ",".join(f"{s*1e3:.0f}" for s in stamps), flush=True)
+
+
+for variant, ub in [
+    ("single", False),
+    ("nocanon", False), ("nocanon", True),
+    ("canon", False), ("canon", True),
+]:
+    run(variant, ub)          # compile + warm
+    run(variant, ub, True)
+    run(variant, ub, True)

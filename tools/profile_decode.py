@@ -38,8 +38,10 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import (  # noqa: E402
-    BATCH, DECODE, HBM_GBPS, PROMPT, flagship_cfg, slope_time,
+    _MODEL_RUN, DECODE, HBM_GBPS, PROMPT, flagship_cfg, slope_time,
 )
+
+BATCH = int(os.environ.get("BENCH_BATCH", 0)) or _MODEL_RUN["1b2"]["batch"]
 
 TRACE_DIR = os.environ.get("PROFILE_TRACE_DIR", "/tmp/llmss_profile")
 
