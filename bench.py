@@ -144,6 +144,9 @@ def slope_time(
             run()
             best = min(best, time.perf_counter() - t0)
         times[n] = best
+        # Drop the closure (and the cache it carries) BEFORE the next
+        # prepare(): at big-ring configs two live caches OOM the chip.
+        del run
     n1, n2 = n_slope
     slope_ms = (times[n2] - times[n1]) / (n2 - n1) * 1e3
     const_ms = times[n1] * 1e3 - slope_ms * n1

@@ -1,27 +1,26 @@
-"""Serving-path benchmark: ContinuousWorker under Poisson arrivals.
+"""Serving-path benchmark: ContinuousWorker under a swept Poisson load.
 
 BASELINE.md configs #4/#5 analogue at single-chip scale — the serving stack
 (broker → continuous batcher → engine) measured under load, not just the
-bare engine loop that ``bench.py`` times. Prints ONE JSON line:
+bare engine loop that ``bench.py`` times. The bench SWEEPS the offered
+Poisson rate over one warmed worker and reports two operating points:
 
-    {"metric": "serve_tokens_per_sec_per_chip", "value": N,
-     "unit": "... p50/p95 TTFT + e2e latency ...", "vs_baseline": N}
+- **capacity**: sustained tok/s/chip at the first saturated rate (where
+  the worker stops keeping up with the offered load — the knee); this is
+  the headline ``value`` and is NOT load-limited;
+- **ttft_sla**: the highest swept rate whose ttft_p50 stays under the
+  BASELINE.md 200 ms target, with its rate/TTFT/throughput.
 
+Prints ONE JSON line; the full sweep table goes to ``SERVE_BENCH.json``.
 ``vs_baseline`` uses the same HBM-roofline definition as ``bench.py`` at
 the worker's row count, so the two lines are directly comparable: the gap
 between them is the price of serving (scheduling, admission prefills,
-token delivery) on top of raw decode. NOTE the reading depends on load:
-below saturation the worker serves every request, so the metric equals the
-*offered* rate (RATE × DECODE tokens/s), not capacity — ``load_limited``
-in the JSON flags this. Measure capacity with a saturating rate
-(``SERVE_RATE=40`` measured 0.448 on v5e at rows=32, r4; the scheduler
-pipelines decode chunks against the host fetch, so the per-chunk
-device→host round-trip is off the critical path — engine/scheduler.py).
+token delivery) on top of raw decode.
 
 Load model: Poisson arrivals (seeded) of 128-token random prompts, 128
-greedy new tokens each, at ``SERVE_RATE`` req/s for ``SERVE_SECONDS``;
-TTFT comes from the engine's prefill stats, end-to-end latency from the
-client side. Writes the full result to ``SERVE_BENCH.json``.
+greedy new tokens each, ``SERVE_SECONDS`` per swept rate. Env overrides:
+``SERVE_RATES`` (comma list, req/s), ``SERVE_ROWS``, ``SERVE_CHUNK``,
+``SERVE_SECONDS``.
 """
 
 from __future__ import annotations
@@ -37,9 +36,93 @@ import numpy as np
 
 from bench import DECODE, PROMPT, flagship_cfg, roofline_tokens_per_sec
 
-RATE = float(os.environ.get("SERVE_RATE", 24.0))  # requests/sec
-SECONDS = float(os.environ.get("SERVE_SECONDS", 30.0))
+RATES = [
+    float(r) for r in os.environ.get(
+        "SERVE_RATES", "12,20,28,36,44,52"
+    ).split(",")
+]
+SECONDS = float(os.environ.get("SERVE_SECONDS", 20.0))
 ROWS = int(os.environ.get("SERVE_ROWS", 32))
+CHUNK = int(os.environ.get("SERVE_CHUNK", 16))
+CHUNK_LOW = int(os.environ.get("SERVE_CHUNK_LOW", 8))
+SLA_MS = float(os.environ.get("SERVE_SLA_MS", 200.0))
+
+
+def run_window(worker, broker, make_req, rate: float, seconds: float,
+               n_dev: int) -> dict:
+    """One measurement window at a fixed Poisson rate on the (already
+    warm) worker. Returns the operating-point stats."""
+    from llmss_tpu.utils.metrics import EngineMetrics
+
+    engine = worker.engine
+    engine.metrics = EngineMetrics()
+    lat: dict[str, float] = {}
+    lat_lock = threading.Lock()
+    submitted: list[str] = []
+    stop_client = threading.Event()
+
+    def waiter(req_id: str, t_submit: float):
+        resp = broker.wait_response(req_id, timeout=seconds * 3 + 120)
+        if resp is not None and resp.error is None:
+            with lat_lock:
+                lat[req_id] = time.time() - t_submit
+
+    def client():
+        arr_rng = np.random.default_rng(int(rate * 1000) % 2**31)
+        t_end = time.time() + seconds
+        while time.time() < t_end and not stop_client.is_set():
+            time.sleep(arr_rng.exponential(1.0 / rate))
+            req = make_req()
+            t0 = time.time()
+            broker.push_request(req)
+            submitted.append(req.id)
+            threading.Thread(
+                target=waiter, args=(req.id, t0), daemon=True
+            ).start()
+
+    ct = threading.Thread(target=client, daemon=True)
+    t_start = time.time()
+    ct.start()
+    while ct.is_alive() or not worker.batcher.idle:
+        worker.run_once()
+        if time.time() - t_start > seconds * 3 + 180:
+            stop_client.set()
+            break
+    t_wall = time.time() - t_start
+
+    m = engine.metrics.to_dict()
+    lat_sorted = sorted(lat.values())
+
+    def pct(q):
+        return (
+            round(lat_sorted[min(int(q / 100 * len(lat_sorted)),
+                                 len(lat_sorted) - 1)], 2)
+            if lat_sorted else None
+        )
+
+    toks = m["tokens_generated"]
+    offered_tps = rate * DECODE
+    serve_tps = toks / t_wall / n_dev
+    ttft_p50 = m["ttft"]["p50_ms"] or 0.0
+    # Saturated = the worker did not keep up with the offered token rate
+    # (drained slower than offered) or queueing blew the latency up.
+    saturated = bool(
+        serve_tps * n_dev < 0.9 * offered_tps or ttft_p50 > 1500.0
+    )
+    return {
+        "rate_req_s": rate,
+        "tok_s_chip": round(serve_tps, 1),
+        "offered_tok_s": round(offered_tps, 1),
+        "served": len(lat),
+        "submitted": len(submitted),
+        "ttft_p50_ms": ttft_p50,
+        "ttft_p95_ms": m["ttft"]["p95_ms"],
+        "e2e_p50_s": pct(50),
+        "e2e_p95_s": pct(95),
+        "decode_step_p50_ms": m["decode_step"]["p50_ms"],
+        "saturated": saturated,
+        "wall_s": round(t_wall, 1),
+    }
 
 
 def main():
@@ -52,7 +135,7 @@ def main():
 
     n_dev = len(jax.devices())
     mesh = make_mesh(MeshPlan(tp=n_dev))
-    cfg = flagship_cfg()
+    cfg = flagship_cfg("1b2")
     params = init_params(cfg, mesh, jax.random.key(0))
     n_params = sum(np.prod(x.shape) for x in jax.tree.leaves(params))
     param_bytes = float(n_params) * 2
@@ -60,8 +143,8 @@ def main():
     engine = DecodeEngine(cfg, params, mesh, max_seq_len=max_seq)
     broker = InProcBroker()
     worker = ContinuousWorker(
-        engine, broker, tokenizer=None, rows=ROWS,
-        chunk_steps=int(os.environ.get("SERVE_CHUNK", 32)),
+        engine, broker, tokenizer=None, rows=ROWS, chunk_steps=CHUNK,
+        chunk_steps_low=CHUNK_LOW,
     )
 
     rng = np.random.default_rng(0)
@@ -74,10 +157,26 @@ def main():
             is_greedy=True,
         )
 
+    # Host<->device round-trip latency: every scheduler iteration pays
+    # one token fetch, so ~2x this RTT (+ prefill) is the hard TTFT floor
+    # of the pipelined loop on THIS host. On the axon bench host the
+    # tunnel adds ~90 ms; a co-located TPU VM host is <1 ms.
+    import jax.numpy as jnp
+    x = jnp.zeros((), jnp.int32) + 1
+    _ = int(x)
+    rtts = []
+    for _i in range(5):
+        t0 = time.time()
+        _ = int(jnp.zeros((), jnp.int32) + 1)
+        rtts.append(time.time() - t0)
+    host_rtt_ms = round(min(rtts) * 1e3, 1)
+    print(f"# host_rtt_ms={host_rtt_ms}", flush=True)
+
     # -- warmup: compile the full serving envelope for this load shape ----
     t0 = time.time()
     n_exec = worker.prewarm(seq_buckets=[PROMPT])
-    print(f"# prewarmed {n_exec} executables in {time.time() - t0:.0f}s")
+    print(f"# prewarmed {n_exec} executables in {time.time() - t0:.0f}s",
+          flush=True)
     warm_ids = []
     for _ in range(ROWS):
         r = make_req()
@@ -92,87 +191,55 @@ def main():
         ]
     assert not warm_ids, "warmup did not complete"
 
-    # -- load phase --------------------------------------------------------
-    lat: dict[str, float] = {}
-    lat_lock = threading.Lock()
-    submitted = []
-    stop_client = threading.Event()
-
-    def waiter(req_id: str, t_submit: float):
-        resp = broker.wait_response(req_id, timeout=SECONDS * 3 + 120)
-        if resp is not None and resp.error is None:
-            with lat_lock:
-                lat[req_id] = time.time() - t_submit
-
-    def client():
-        arr_rng = np.random.default_rng(7)
-        t_end = time.time() + SECONDS
-        while time.time() < t_end and not stop_client.is_set():
-            time.sleep(arr_rng.exponential(1.0 / RATE))
-            req = make_req()
-            t0 = time.time()
-            broker.push_request(req)
-            submitted.append(req.id)
-            threading.Thread(
-                target=waiter, args=(req.id, t0), daemon=True
-            ).start()
-
-    # Reset metrics so the report covers only the measured window.
-    from llmss_tpu.utils.metrics import EngineMetrics
-
-    engine.metrics = EngineMetrics()
-
-    ct = threading.Thread(target=client, daemon=True)
-    t_start = time.time()
-    ct.start()
-    # Worker loop on the main thread until the client stops and the batch
-    # drains.
-    while ct.is_alive() or not worker.batcher.idle:
-        worker.run_once()
-        if time.time() - t_start > SECONDS * 3 + 240:
-            stop_client.set()
+    # -- sweep -------------------------------------------------------------
+    sweep = []
+    for rate in RATES:
+        w = run_window(worker, broker, make_req, rate, SECONDS, n_dev)
+        sweep.append(w)
+        print(f"# rate={rate} -> {json.dumps(w)}", flush=True)
+        if w["saturated"]:
             break
-    t_wall = time.time() - t_start
 
-    m = engine.metrics.to_dict()
-    done = len(lat)
-    lat_sorted = sorted(lat.values())
-
-    def pct(q):
-        return (
-            round(lat_sorted[min(int(q / 100 * len(lat_sorted)),
-                                 len(lat_sorted) - 1)], 2)
-            if lat_sorted else None
-        )
-
-    toks = m["tokens_generated"]
-    serve_tps = toks / t_wall / n_dev
+    sat = next((w for w in sweep if w["saturated"]), None)
+    capacity = sat or sweep[-1]
+    sla = [w for w in sweep if (w["ttft_p50_ms"] or 1e9) < SLA_MS]
+    best_sla = max(sla, key=lambda w: w["rate_req_s"]) if sla else None
 
     roofline = roofline_tokens_per_sec(cfg, param_bytes, ROWS, max_seq)
-
-    # Below saturation the worker keeps up (no queue buildup — small
-    # TTFT) and the metric equals offered load, not capacity.
     result = {
         "metric": "serve_tokens_per_sec_per_chip",
-        "value": round(serve_tps, 1),
-        "load_limited": bool(
-            done == len(submitted)
-            and (m["ttft"]["p50_ms"] or 0) < 1000.0
-        ),
+        "value": capacity["tok_s_chip"],
+        "load_limited": not capacity["saturated"],
         "unit": (
-            f"tok/s/chip (1.2B-class bf16, continuous batching rows={ROWS}, "
-            f"poisson {RATE} req/s x {SECONDS:.0f}s, {done}/"
-            f"{len(submitted)} served, ttft_p50={m['ttft']['p50_ms']}ms "
-            f"p95={m['ttft']['p95_ms']}ms, e2e_p50={pct(50)}s "
-            f"p95={pct(95)}s, decode_step_p50="
-            f"{m['decode_step']['p50_ms']}ms)"
+            f"tok/s/chip (1.2B-class bf16, continuous batching rows={ROWS} "
+            f"chunk={CHUNK}/{CHUNK_LOW}, capacity at poisson "
+            f"{capacity['rate_req_s']} req/s x {SECONDS:.0f}s: "
+            f"{capacity['served']}/{capacity['submitted']} served, "
+            f"ttft_p50={capacity['ttft_p50_ms']}ms "
+            f"p95={capacity['ttft_p95_ms']}ms, "
+            f"e2e_p50={capacity['e2e_p50_s']}s; "
+            + (
+                f"sla<{SLA_MS:.0f}ms holds to "
+                f"{best_sla['rate_req_s']} req/s "
+                f"(ttft_p50={best_sla['ttft_p50_ms']}ms, "
+                f"{best_sla['tok_s_chip']} tok/s/chip)"
+                if best_sla else
+                f"no swept rate met ttft_p50<{SLA_MS:.0f}ms: host rtt "
+                f"{host_rtt_ms}ms puts the pipelined-loop TTFT floor at "
+                f"~{round(2 * host_rtt_ms + 50)}ms on this host"
+            )
+            + ")"
         ),
-        "vs_baseline": round(serve_tps / roofline, 3),
+        "host_rtt_ms": host_rtt_ms,
+        "vs_baseline": round(capacity["tok_s_chip"] / roofline, 3),
     }
     print(json.dumps(result))
     with open("SERVE_BENCH.json", "w") as f:
-        json.dump({**result, "raw_metrics": m, "wall_s": round(t_wall, 1)},
-                  f, indent=1)
+        json.dump(
+            {**result, "sla_ms": SLA_MS, "best_sla": best_sla,
+             "sweep": sweep},
+            f, indent=1,
+        )
 
 
 if __name__ == "__main__":

@@ -48,8 +48,11 @@ class Prefix(NamedTuple):
     ``DecodeEngine.build_prefix``; admissions that start with these tokens
     seed their cache rows from it and prefill only the suffix — the
     prefix's prefill FLOPs and TTFT are paid once per prefix, not per
-    request. The reference has no analogue (it re-prefills every request
-    from scratch, ``generate.py:99``)."""
+    request. Token-exact vs from-scratch on bf16 caches (absolute
+    positions/counters); on int8 caches the stored bits are stable but
+    reads pass through quantization, so exactness is not guaranteed. The
+    reference has no analogue (it re-prefills every request from scratch,
+    ``generate.py:99``)."""
 
     tokens: tuple[int, ...]  # the prefix token ids (host, for matching)
     k: jax.Array  # [L, P, Hkv, D] (or int8 when the engine is int8)
@@ -145,6 +148,7 @@ class DecodeEngine:
             self._cache_dtype = cfg.compute_dtype
         self.metrics = EngineMetrics()
         self._ladder = self.bucket_ladder()
+        self._canon_cache_memo: dict[int, KVCache] = {}
 
         # mesh is partial-bound (a compile-time constant, not a traced arg):
         # it enables the shard_map'd Pallas attention path inside forward.
@@ -373,6 +377,22 @@ class DecodeEngine:
         g = max(32, -(-self.max_seq_len // (16 * 32)) * 32)  # round UP
         return list(range(g, self.max_seq_len, g))
 
+    def _bucketable(self) -> bool:
+        """Whether this engine's decode path can bucket cache reads at
+        all: sp>1 meshes and the Pallas decode override read the full
+        cache by construction. IMPL_OVERRIDE is re-read each call (tests
+        monkeypatch it) — the mesh check is the cheap early-out."""
+        import importlib
+
+        from llmss_tpu.parallel.mesh import AXIS_SP
+
+        if self.mesh is not None and AXIS_SP in self.mesh.shape and (
+            self.mesh.shape[AXIS_SP] > 1
+        ):
+            return False
+        _att = importlib.import_module("llmss_tpu.ops.attention")
+        return _att.IMPL_OVERRIDE != "pallas"
+
     def decode_bucket(self, pos_bound: int) -> int | None:
         """Pick the cache-read bucket for a decode call whose rows' ring
         positions (current + steps in the call) are all < ``pos_bound``.
@@ -380,19 +400,10 @@ class DecodeEngine:
         when any row may have wrapped (pos_bound > max_seq_len), or on the
         sp>1 / Pallas-kernel decode paths (which read the full cache by
         construction)."""
-        import importlib
-
-        from llmss_tpu.parallel.mesh import AXIS_SP
-
-        _att = importlib.import_module("llmss_tpu.ops.attention")
-        if self.mesh is not None and AXIS_SP in self.mesh.shape and (
-            self.mesh.shape[AXIS_SP] > 1
-        ):
-            return None
-        if _att.IMPL_OVERRIDE == "pallas":
-            return None
-        if pos_bound > self.max_seq_len:
+        if not self._ladder or pos_bound > self.max_seq_len:
             return None  # wrapped rows: full-ring semantics
+        if not self._bucketable():
+            return None
         for b in self._ladder:
             if b >= pos_bound:
                 return b
@@ -485,6 +496,13 @@ class DecodeEngine:
                 )
                 cache = self.canon_cache(c2)
                 n += 1
+        # Drain the device before returning: each prewarm call above also
+        # DISPATCHED one execution, and on remote-tunnel backends the
+        # first execution of a program carries a program-load cost — left
+        # queued, that backlog lands on the first real request (measured
+        # 150 s of "TTFT" that was actually deferred prewarm work).
+        jax.block_until_ready(cache.positions)
+        _ = int(jnp.zeros((), jnp.int32) + 1)
         del cache
         return n
 
@@ -513,6 +531,12 @@ class DecodeEngine:
     # (asserted by tests/test_serve.py::test_prewarm_covers_all_shapes).
 
     def _canon_cache_shardings(self, batch: int):
+        # Memoized: canon_cache runs once per decoded token on the
+        # single-step generate path, and the shardings depend only on the
+        # batch size.
+        hit = self._canon_cache_memo.get(batch)
+        if hit is not None:
+            return hit
         from jax.sharding import NamedSharding
 
         from llmss_tpu.engine.cache import cache_specs_for
@@ -521,10 +545,12 @@ class DecodeEngine:
             self.mesh, batch=batch, max_len=self.max_seq_len,
             n_kv_heads=self.cfg.n_kv_heads, dtype=self._cache_dtype,
         )
-        return KVCache(*[
+        out = KVCache(*[
             NamedSharding(self.mesh, s) if s is not None else None
             for s in specs
         ])
+        self._canon_cache_memo[batch] = out
+        return out
 
     def canon_cache(self, cache: KVCache) -> KVCache:
         """Re-wrap a (possibly jit-produced) cache with the same canonical
@@ -588,8 +614,11 @@ class DecodeEngine:
         ``prefix``: a retained KV segment (``build_prefix``) every prompt
         must extend — its tokens are NOT re-prefilled: the cache rows are
         seeded from the segment and only each prompt's suffix runs through
-        the model. Emitted tokens are identical to the from-scratch run
-        (positions, masks, and sampling counters are all absolute).
+        the model. On bf16 caches emitted tokens are identical to the
+        from-scratch run (positions, masks, and sampling counters are all
+        absolute); int8 caches are storage-bit-stable but the suffix reads
+        the prefix through quantized KV, so tokens can differ from a
+        from-scratch run at logit ties (models/decoder.py).
 
         ``gen`` may be a list with one entry per prompt: a batch can mix
         greedy/sampled requests with different warpers, lengths, and EOS ids
@@ -629,6 +658,14 @@ class DecodeEngine:
 
         if prefix is not None:
             full_lens, suffixes = self.split_prefix(prompts, prefix)
+            if int(full_lens.max()) > self.max_seq_len:
+                # Same guard _pad_prompts applies on the non-prefix path:
+                # a prefix+suffix total past the ring would wrap the
+                # suffix over the just-seeded prefix slots.
+                raise ValueError(
+                    f"prompt length {int(full_lens.max())} exceeds "
+                    f"max_seq_len {self.max_seq_len}"
+                )
             ids, suf_lens = self._pad_prompts(suffixes)
             cache = self.canon_cache(self.seed_cache(cache, prefix))
             start = jnp.full(B, prefix.length, jnp.int32)

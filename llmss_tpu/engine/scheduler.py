@@ -224,6 +224,18 @@ class ContinuousBatcher:
                         jnp.zeros(P, np.int32),
                     )
                     n_compiled += 1
+                    # build_prefix itself runs through the ENGINE's own
+                    # _prefill jit at batch=1 — a separate jit object from
+                    # _prefill_row — so the first prefix build would
+                    # otherwise compile mid-serve.
+                    c1 = eng.new_cache(1)
+                    sa1 = eng._sample_args(GenerationParams(), 1)
+                    _, _, c1 = eng._prefill(
+                        eng.params, jnp.zeros((1, S), np.int32), c1,
+                        jnp.ones(1, np.int32), sa1,
+                    )
+                    del c1
+                    n_compiled += 1
             # Insert with all-dropped indices: compiles the P-shaped
             # scatter without touching live rows. Once — the live path
             # feeds it exactly these canonical shardings.
@@ -271,6 +283,13 @@ class ContinuousBatcher:
         )
         self._cur_pos_dev = eng.canon_vec(jnp.zeros(self.rows, jnp.int32))
         self._tokens_dev = eng.canon_vec(jnp.zeros(self.rows, jnp.int32))
+        # Drain the device queue before declaring warm: prewarm dispatched
+        # one execution per compiled program, and remote-tunnel backends
+        # pay a per-program first-run load — queued up, that backlog would
+        # otherwise land on the first real admission (engine.prewarm has
+        # the same guard).
+        jax.block_until_ready(self.cache.positions)
+        _ = int(jnp.zeros((), jnp.int32) + 1)
         return n_compiled
 
     # -- submission ---------------------------------------------------------

@@ -232,6 +232,7 @@ class ContinuousWorker:
         rows: int = 8,
         poll_timeout_s: float = 0.02,
         chunk_steps: int = 8,
+        chunk_steps_low: int | None = None,
     ):
         from llmss_tpu.engine.scheduler import ContinuousBatcher
 
@@ -239,10 +240,17 @@ class ContinuousWorker:
         self.broker = broker
         self.tokenizer = tokenizer
         self.batcher = ContinuousBatcher(
-            engine, rows=rows, chunk_steps=chunk_steps
+            engine, rows=rows, chunk_steps=chunk_steps,
+            chunk_steps_low=chunk_steps_low,
         )
         self.poll_timeout_s = poll_timeout_s
         self._publish_counter = 0
+        # Retained prefix segments keyed by their token tuple (LRU):
+        # requests carrying ``prefix_token_ids`` build the segment once
+        # (engine.build_prefix) and every later request sharing it seeds
+        # from device-resident KV instead of re-prefilling the prefix.
+        self._prefixes: "dict[tuple, object]" = {}
+        self.max_prefixes = 4
 
     def prewarm(
         self, seq_buckets: list[int] | None = None,
@@ -299,8 +307,13 @@ class ContinuousWorker:
                     self.broker.push_stream(req.id, new_toks)
 
             try:
+                prefix = (
+                    self._get_prefix(req.prefix_token_ids)
+                    if req.prefix_token_ids else None
+                )
                 self.batcher.submit(
-                    ids, gen, cb, req_id=req.id, stream_cb=stream_cb
+                    ids, gen, cb, req_id=req.id, stream_cb=stream_cb,
+                    prefix=prefix,
                 )
             except ValueError as e:  # e.g. prompt + max_new exceeds the ring
                 self.broker.push_response(
@@ -308,6 +321,19 @@ class ContinuousWorker:
                 )
                 continue
             n += 1
+
+    def _get_prefix(self, prefix_ids: list[int]):
+        """Retained prefix for these tokens, building (and LRU-evicting)
+        on first use. Build cost is one prefill — paid once per distinct
+        prefix, amortized over every request that shares it."""
+        key = tuple(prefix_ids)
+        pfx = self._prefixes.pop(key, None)
+        if pfx is None:
+            pfx = self.engine.build_prefix(list(prefix_ids))
+        self._prefixes[key] = pfx  # most-recently-used at the end
+        while len(self._prefixes) > self.max_prefixes:
+            self._prefixes.pop(next(iter(self._prefixes)))
+        return pfx
 
     def run_once(self) -> int:
         # Check the broker's TTL'd cancellation flags for exactly the ids

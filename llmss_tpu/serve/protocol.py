@@ -28,6 +28,13 @@ class GenerateRequest:
     # stream channel (producer serves them as SSE events) as they decode;
     # the final GenerateResponse still closes the request.
     stream: bool = False
+    # Prefix-reuse hint: these ids must be a proper prefix of token_ids
+    # (shared system prompt / earlier session turns). A continuous worker
+    # prefills the segment once, retains it, and later requests seed
+    # their cache rows from it — identical tokens, shared prefill paid
+    # once. Purely an optimization: workers without prefix support (the
+    # batch Worker) ignore it.
+    prefix_token_ids: list[int] | None = None
     id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
 
     def to_json(self) -> str:
@@ -51,6 +58,16 @@ class GenerateRequest:
                 raise ValueError("top_k must be >= 0")
         if self.max_new_tokens <= 0:
             raise ValueError("max_new_tokens must be > 0")
+        if self.prefix_token_ids is not None:
+            if self.token_ids is None:
+                raise ValueError("prefix_token_ids requires token_ids")
+            P = len(self.prefix_token_ids)
+            if not 0 < P < len(self.token_ids) or (
+                self.token_ids[:P] != list(self.prefix_token_ids)
+            ):
+                raise ValueError(
+                    "prefix_token_ids must be a proper prefix of token_ids"
+                )
 
 
 @dataclasses.dataclass

@@ -25,12 +25,14 @@ class LatencyStat:
         self._samples: list[float] = []
         self._count = 0
         self._total = 0.0
+        self.last_s: float | None = None  # most recent sample (seconds)
         self._lock = threading.Lock()
 
     def record(self, seconds: float) -> None:
         with self._lock:
             self._count += 1
             self._total += seconds
+            self.last_s = seconds
             if len(self._samples) >= self.max_samples:
                 # overwrite pseudo-randomly to keep a sliding reservoir
                 self._samples[self._count % self.max_samples] = seconds

@@ -81,6 +81,13 @@ def test_engine_prefix_validation(setup):
         eng.build_prefix([])
     with pytest.raises(ValueError, match="prefix length"):
         eng.build_prefix([1] * 64)
+    # prefix + suffix past the ring must raise like the non-prefix path
+    # (a wrapped suffix would overwrite the just-seeded prefix slots).
+    long_pfx = eng.build_prefix(list(range(1, 61)))
+    with pytest.raises(ValueError, match="exceeds"):
+        eng.generate(
+            [list(range(1, 61)) + [9] * 10], gen, prefix=long_pfx,
+        )
 
 
 def test_scheduler_prefix_identical_tokens(setup):
@@ -133,3 +140,57 @@ def test_prefix_int8_storage_stable(setup):
     a = eng.generate(prompts, gen, prefix=pfx)
     bb = eng.generate(prompts, gen, prefix=pfx)
     assert a == bb
+
+
+def test_serving_prefix_token_ids_end_to_end(setup):
+    """The wire-level prefix hint: requests carrying prefix_token_ids
+    through broker -> ContinuousWorker produce exactly the tokens of the
+    same request without the hint (it is purely an optimization), and the
+    worker retains the segment across requests."""
+    from llmss_tpu.serve.broker import InProcBroker
+    from llmss_tpu.serve.consumer import ContinuousWorker
+    from llmss_tpu.serve.protocol import GenerateRequest
+
+    cfg, params, mesh = setup
+    eng = DecodeEngine(cfg, params, mesh, max_seq_len=64)
+    broker = InProcBroker()
+    worker = ContinuousWorker(
+        eng, broker, tokenizer=None, rows=2, poll_timeout_s=0.01,
+        chunk_steps=2,
+    )
+    full = PREFIX + [50, 51]
+
+    def serve(req):
+        broker.push_request(req)
+        import time as _t
+        deadline = _t.time() + 120
+        while _t.time() < deadline:
+            worker.run_once()
+            r = broker.wait_response(req.id, timeout=0.001)
+            if r is not None:
+                return r
+        raise TimeoutError
+
+    plain = serve(GenerateRequest(
+        id="np", token_ids=full, max_new_tokens=8, is_greedy=True,
+    ))
+    with_pfx = serve(GenerateRequest(
+        id="wp", token_ids=full, max_new_tokens=8, is_greedy=True,
+        prefix_token_ids=list(PREFIX),
+    ))
+    assert plain.error is None and with_pfx.error is None
+    assert with_pfx.token_ids == plain.token_ids
+    assert len(worker._prefixes) == 1  # segment retained
+    # Second request reuses the retained segment (no rebuild).
+    again = serve(GenerateRequest(
+        id="wp2", token_ids=PREFIX + [60, 61], max_new_tokens=8,
+        is_greedy=True, prefix_token_ids=list(PREFIX),
+    ))
+    assert again.error is None and len(worker._prefixes) == 1
+
+    # Malformed hint -> per-request error, worker stays up.
+    bad = serve(GenerateRequest(
+        id="bad", token_ids=[1, 2, 3], max_new_tokens=4, is_greedy=True,
+        prefix_token_ids=[9, 9],
+    ))
+    assert bad.error is not None and "prefix" in bad.error

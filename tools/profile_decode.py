@@ -296,21 +296,27 @@ argmax/top-k/top-p with a full-logits-reduction token derivation.
 - The per-layer weight `dot_general`s stream at ~680 GB/s (83% of peak):
   the scan's weight slices are prefetched into alternate memory by XLA
   (the `S(1)` copies in the HLO) and are near the practical ceiling.
-- The attention-over-cache cost (`no_attn` delta) is dominated by the
-  per-layer K slice+transpose copy feeding the score dot plus the masked
-  softmax chain. Round 4 measured the design space exhaustively on-chip
-  (see git history): a head-major `[L,B,Hkv,T,D]` cache makes XLA rewrite
-  the G=1 dots into lane-dim-reduce fusions (379 GB/s — slower); a
-  K-transposed `[L,B,Hkv,D,T]` cache makes the reads fuse at 744 GB/s in
-  isolation but the T-minor column scatter costs ~1.2 ms/step (tile
-  read-modify-write) and real-model fusion breaks re-materialize the
-  copies — net slower. The shipped layout stays seq-major with the V
-  contraction hand-written as a major-dim multiply+reduce, rope sin/cos
-  and the decode mask penalty hoisted out of the layer scan (each breaks
-  the cache-read fusion when computed per layer: +0.67 ms and
-  +0.6 ms/step respectively). A *dynamic* score mask (any mask whose
-  values aren't compile-time constants) costs ~0.6 ms/step over a
-  foldable one — the remaining gap to the stream floor.
+- The attention-over-cache cost (`no_attn` delta) is essentially the
+  HBM stream cost of the KV bytes read: the per-layer cache
+  `dynamic-slice` copies land in alternate memory (`S(1)` in the HLO —
+  on-chip), so the only HBM traffic is the read itself. Round 4 measured
+  alternative layouts exhaustively on-chip (head-major, K-transposed —
+  both net slower, see git history); round 5 re-measured the mask-variant
+  space (`tools/exp_mask.py`: additive penalty / inline iota / post-exp
+  multiplicative / no mask at all are within noise of each other — the
+  r4 "dynamic mask costs 0.6 ms" diagnosis no longer reproduces) and
+  concluded the full-ring step simply runs at the chip's practical
+  transfer efficiency (~690 GB/s ≈ 84% of nominal, the same rate the
+  weight stream achieves).
+- The remaining lever was therefore to read FEWER bytes: the engine's
+  **bucketed cache reads** (round 5) slice each layer's KV fetch to the
+  ring prefix covering live context via a hand-emitted
+  `lax.dynamic_slice` — the serving path's decode cost follows occupancy,
+  not ring size (`bench.py` measures that path; the ablations here run
+  the full-ring step, the worst case). Emitting the small slice directly
+  matters: XLA does not fold a static T-slice into the scan's per-layer
+  slice (pre-scan slicing materializes a fresh operand, +1.3 ms/step;
+  in-body slicing adds an HBM round-trip, +0.3 ms/step).
 - The post-scan deferred KV scatter now fuses to ~0 marginal cost (the
   `no_scatter` delta); round 3 measured it at 0.08 ms.
 - IDLE in the trace is host-side gaps of `generate_fused` (tunnel fetch

