@@ -787,6 +787,19 @@ class DecodeEngine:
         )
         return out
 
+    def generate_speculative(
+        self, prompts: list[list[int]], gen: GenerationParams, *,
+        gamma: int = 4, ngram: int = 3,
+    ) -> list[list[int]]:
+        """Greedy generation with prompt-lookup speculative decoding:
+        exactly ``generate``'s tokens, 1..gamma+1 of them per forward /
+        host round-trip (engine/speculative.py)."""
+        from llmss_tpu.engine.speculative import generate_speculative
+
+        return generate_speculative(
+            self, prompts, gen, gamma=gamma, ngram=ngram
+        )
+
     def generate_fused(
         self, prompts: list[list[int]], gen: GenerationParams
     ) -> list[list[int]]:

@@ -38,7 +38,9 @@ from llmss_tpu.ops.attention import (
     decode_mask_penalty,
     dispatch_attention,
     fresh_kv_decode_attention,
+    fresh_kv_window_attention,
     make_causal_mask,
+    window_mask_penalty,
 )
 from llmss_tpu.ops.layers import (
     LinearParams, NormParams, dense, dense_t, embedding,
@@ -526,13 +528,25 @@ def forward(
     sp_attn = None
     if S == 1 and mesh is not None and mesh.shape[AXIS_SP] > 1:
         sp_attn = _make_sp_decode_attn(cfg, mesh, cache, positions, slots)
-    defer_write = S == 1 and (
-        mesh is None or mesh.shape[AXIS_SP] == 1 or sp_attn is not None
+    # Small decode windows (speculative verify: a handful of tokens per
+    # row) also take the deferred-write path via the windowed fresh-KV
+    # merge — one post-scan scatter + bucketable cache reads instead of
+    # the prefill machinery (L in-scan scatters, materialized masks).
+    window_defer = (
+        1 < S <= 8
+        and cfg.sliding_window is None
+        and not cache.quantized
+        and (mesh is None or mesh.shape[AXIS_SP] == 1)
+    )
+    defer_write = window_defer or (
+        S == 1 and (
+            mesh is None or mesh.shape[AXIS_SP] == 1 or sp_attn is not None
+        )
     )
 
     quant = cache.quantized
     if defer_write:
-        kernel_attn = None if quant else _make_decode_kernel_attn(
+        kernel_attn = None if (quant or S > 1) else _make_decode_kernel_attn(
             cfg, mesh, cache, positions, slots
         )
         if kernel_attn is not None and _ablate is None:
@@ -579,10 +593,26 @@ def forward(
                 if bucket is not None else cache.positions
             )
             penalty = None
+            win_attn = None
             if sp_attn is None:
-                penalty = decode_mask_penalty(
-                    positions, kv_pos_src, slots, cfg.sliding_window
-                )
+                if S == 1:
+                    penalty = decode_mask_penalty(
+                        positions, kv_pos_src, slots, cfg.sliding_window
+                    )
+                else:
+                    # Windowed fresh-KV merge: one [B, T] cache penalty
+                    # (every pre-window slot is visible to all window
+                    # queries) + a compile-time triangular intra-window
+                    # mask inside the attention itself.
+                    penalty_w = window_mask_penalty(
+                        positions[:, :1], kv_pos_src, slots
+                    )
+
+                    def win_attn(q, k_new, v_new, k_c, v_c):
+                        return fresh_kv_window_attention(
+                            q, k_c, v_c, k_new, v_new, penalty_w,
+                            scale=cfg.attn_scale,
+                        )
             B = input_ids.shape[0]
             Hkv, D = cfg.n_kv_heads, cfg.head_dim
 
@@ -624,7 +654,9 @@ def forward(
                 h, k_f, v_f = _block(
                     cfg, bp, h, positions, k_l, v_l, kv_pos_src, slots,
                     None, mesh=mesh, defer_write=True,
-                    attn_override=sp_attn, ablate=_ablate,
+                    attn_override=sp_attn if sp_attn is not None
+                    else win_attn,
+                    ablate=_ablate,
                     sin_cos=sin_cos, penalty=penalty,
                     k_scale=ks_l, v_scale=vs_l,
                 )

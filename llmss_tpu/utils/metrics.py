@@ -79,6 +79,9 @@ class EngineMetrics:
     """Aggregated counters for one engine/worker."""
 
     def __init__(self):
+        # Last speculative-decoding call's acceptance stats (set by
+        # engine/speculative.py; None until a speculative call runs).
+        self.spec_stats: dict | None = None
         self.ttft = LatencyStat("ttft")
         self.decode_step = LatencyStat("decode_step")
         self.prefill = LatencyStat("prefill")
@@ -122,6 +125,10 @@ class EngineMetrics:
             "ttft": self.ttft.to_dict(),
             "prefill": self.prefill.to_dict(),
             "decode_step": self.decode_step.to_dict(),
+            **(
+                {"speculative": self.spec_stats}
+                if self.spec_stats is not None else {}
+            ),
         }
 
 

@@ -1,0 +1,113 @@
+"""Prompt-lookup speculative decoding: exact greedy parity, fewer
+forwards.
+
+Verification is exact — ``generate_speculative`` must emit token-for-token
+what plain greedy ``generate`` emits, on every workload shape: repetitive
+prompts (speculation hits), random prompts (speculation misses — degrades
+to normal steps, never to wrong tokens), EOS mid-draft, near-ring rows
+(falls back to plain steps), and desynchronized row lengths.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from llmss_tpu.engine import DecodeEngine, GenerationParams
+from llmss_tpu.engine.speculative import lookup_draft
+from llmss_tpu.parallel import MeshPlan, make_mesh
+from tests.test_bucket import _cfg
+
+
+@pytest.fixture(scope="module")
+def engine(devices):
+    mesh = make_mesh(MeshPlan(dp=2, tp=4))
+    cfg = _cfg()
+    from llmss_tpu.models.decoder import init_params
+
+    params = init_params(cfg, mesh, jax.random.key(0))
+    return DecodeEngine(cfg, params, mesh, max_seq_len=64)
+
+
+def test_lookup_draft_basics():
+    # trailing 3-gram [4,5,6] occurred before, followed by 7, 8
+    assert lookup_draft([1, 4, 5, 6, 7, 8, 2, 4, 5, 6], 2) == [7, 8]
+    # no match anywhere -> repeat last token
+    assert lookup_draft([1, 2, 3], 3) == [3, 3, 3]
+    # shorter-n fallback: 1-gram [2] matched, continuation padded
+    assert lookup_draft([9, 2, 7, 2], 3)[0] == 7
+    # single-token history
+    assert lookup_draft([5], 2) == [5, 5]
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 4])
+def test_exact_greedy_parity(engine, gamma):
+    rng = np.random.default_rng(0)
+    prompts = [
+        # repetitive: speculation should hit
+        [7, 3, 9, 7, 3, 9, 7, 3, 9, 7, 3],
+        # random: speculation mostly misses
+        rng.integers(1, 64, 10).tolist(),
+        # short
+        [5],
+    ]
+    gen = GenerationParams(max_new_tokens=20, is_greedy=True)
+    plain = engine.generate(prompts, gen)
+    spec = engine.generate_speculative(prompts, gen, gamma=gamma)
+    assert spec == plain
+    stats = engine.metrics.spec_stats
+    assert stats is not None and stats["tokens_via_speculation"] > 0
+
+
+def test_parity_with_eos_and_mixed_lengths(engine):
+    """EOS can land mid-draft; rows finish at different steps."""
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, 64, n).tolist() for n in (4, 9, 2, 13)]
+    # Find an eos that actually occurs: run plain first, pick a token
+    gen0 = GenerationParams(max_new_tokens=24, is_greedy=True)
+    plain0 = engine.generate(prompts, gen0)
+    eos = plain0[0][len(plain0[0]) // 2]  # some token row 0 emits
+    gen = GenerationParams(
+        max_new_tokens=24, is_greedy=True, eos_token_id=int(eos),
+    )
+    plain = engine.generate(prompts, gen)
+    spec = engine.generate_speculative(prompts, gen, gamma=4)
+    assert spec == plain
+
+
+def test_parity_near_ring_capacity(engine):
+    """Rows whose generation approaches the ring must finish via the
+    plain-step fallback with identical tokens."""
+    prompts = [[3, 1, 4, 1, 5] * 8]  # 40 tokens in a 64-slot ring
+    gen = GenerationParams(max_new_tokens=23, is_greedy=True)
+    plain = engine.generate(prompts, gen)
+    spec = engine.generate_speculative(prompts, gen, gamma=4)
+    assert spec == plain
+
+
+def test_sampled_rejected(engine):
+    with pytest.raises(ValueError, match="greedy"):
+        engine.generate_speculative(
+            [[1, 2]],
+            GenerationParams(max_new_tokens=4, is_greedy=False,
+                             temperature=0.8),
+        )
+
+
+def test_prompt_at_ring_capacity_delegates(engine):
+    """A prompt that (nearly) fills the ring can't speculate — the call
+    must transparently serve plain greedy instead of crashing."""
+    prompts = [[3, 1, 4, 1] * 16]  # 64 tokens == max_seq_len
+    gen = GenerationParams(max_new_tokens=4, is_greedy=True)
+    plain = engine.generate(prompts, gen)
+    spec = engine.generate_speculative(prompts, gen, gamma=4)
+    assert spec == plain
+
+
+def test_stats_reset_between_calls(engine):
+    gen = GenerationParams(max_new_tokens=8, is_greedy=True)
+    engine.generate_speculative([[7, 3, 9] * 4], gen, gamma=2)
+    assert engine.metrics.spec_stats["verify_forwards"] > 0
+    # Near-capacity call -> zero speculation; stats must say so, not echo
+    # the previous call's numbers.
+    engine.generate_speculative([[3, 1, 4, 1] * 16], gen, gamma=4)
+    assert engine.metrics.spec_stats["verify_forwards"] == 0
