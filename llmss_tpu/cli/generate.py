@@ -56,15 +56,26 @@ def get_args(argv=None):
     )
     parser.add_argument("--max_seq_len", type=int, default=None)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--speculative", type=int, default=0, metavar="GAMMA",
+        help="prompt-lookup speculative decoding with GAMMA drafted "
+             "tokens per step (greedy only; 0 = off). Emits the model's "
+             "greedy tokens in fewer forwards on lookup-friendly text.",
+    )
     return parser.parse_args(argv)
 
 
 def main(argv=None):
     args = get_args(argv)
-    # Range asserts, parity with generate.py:37-40.
+    # Range asserts, parity with generate.py:37-40 (checked BEFORE the
+    # model load so a bad flag combination fails in milliseconds).
     assert args.temperature > 0.0
     assert args.top_k >= 0
     assert 0.0 < args.top_p <= 1.0
+    if args.speculative < 0:
+        raise SystemExit("--speculative must be >= 0")
+    if args.speculative > 0 and not args.is_greedy:
+        raise SystemExit("--speculative requires --is_greedy")
 
     start = time.time()
 
@@ -116,11 +127,16 @@ def main(argv=None):
 
     t0 = time.time()
     first_token_at = []
-    out = engine.generate(
-        prompts, gen,
-        on_token=lambda step, toks: first_token_at.append(time.time())
-        if step == 0 else None,
-    )
+    if args.speculative > 0:
+        out = engine.generate_speculative(
+            prompts, gen, gamma=args.speculative,
+        )
+    else:
+        out = engine.generate(
+            prompts, gen,
+            on_token=lambda step, toks: first_token_at.append(time.time())
+            if step == 0 else None,
+        )
     t1 = time.time()
 
     n_generated = sum(len(o) for o in out)
@@ -136,10 +152,18 @@ def main(argv=None):
 
     elapsed = time.time() - start
     ttft_ms = (first_token_at[0] - t0) * 1000 if first_token_at else None
+    ttft_s = f"ttft: {ttft_ms:.1f}ms | " if ttft_ms is not None else ""
+    spec_s = ""
+    if args.speculative > 0 and engine.metrics.spec_stats:
+        st = engine.metrics.spec_stats
+        spec_s = (
+            f"speculation: {st['mean_tokens_per_forward_per_row']} "
+            f"tok/verify | "
+        )
     print(
         f"elapsed: {elapsed:.2f}s | generation: {t1 - t0:.2f}s | "
-        f"ttft: {ttft_ms:.1f}ms | "
-        f"throughput: {n_generated / max(t1 - t0, 1e-9):.1f} tok/s "
+        + ttft_s + spec_s
+        + f"throughput: {n_generated / max(t1 - t0, 1e-9):.1f} tok/s "
         f"on {len(jax.devices())} device(s)"
     )
     return out

@@ -30,3 +30,33 @@ def test_cli_generate(tmp_path, devices, capsys):
     captured = capsys.readouterr().out
     assert "continuation ids" in captured
     assert "ttft" in captured
+
+
+def test_cli_generate_speculative(tmp_path, devices, capsys):
+    import torch
+    import transformers as tr
+
+    torch.manual_seed(3)
+    cfg = tr.GPT2Config(
+        vocab_size=64, n_positions=64, n_embd=32, n_layer=2, n_head=4
+    )
+    tr.GPT2LMHeadModel(cfg).eval().save_pretrained(
+        tmp_path / "m", safe_serialization=True
+    )
+
+    from llmss_tpu.cli.generate import main
+
+    common = [
+        "--pretrained_model_path", str(tmp_path / "m"),
+        "--token_ids", "1,2,3", "4,5,6,7",
+        "--max_new_tokens", "8",
+        "--is_greedy",
+        "--dtype", "float32",
+        "--tp", "4", "--dp", "2",
+        "--max_seq_len", "64",
+    ]
+    plain = main(common)
+    spec = main(common + ["--speculative", "3"])
+    assert spec == plain  # same kernels on CPU -> token-identical
+    captured = capsys.readouterr().out
+    assert "speculation:" in captured
