@@ -38,11 +38,11 @@ from bench import DECODE, PROMPT, flagship_cfg, roofline_tokens_per_sec
 
 RATES = [
     float(r) for r in os.environ.get(
-        "SERVE_RATES", "20,28,36,44,52"
+        "SERVE_RATES", "28,36,44,52,60"
     ).split(",")
 ]
 SECONDS = float(os.environ.get("SERVE_SECONDS", 20.0))
-ROWS = int(os.environ.get("SERVE_ROWS", 48))
+ROWS = int(os.environ.get("SERVE_ROWS", 64))
 CHUNK = int(os.environ.get("SERVE_CHUNK", 16))
 CHUNK_LOW = int(os.environ.get("SERVE_CHUNK_LOW", 8))
 SLA_MS = float(os.environ.get("SERVE_SLA_MS", 200.0))
