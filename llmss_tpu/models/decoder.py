@@ -46,7 +46,9 @@ from llmss_tpu.ops.layers import (
     LinearParams, NormParams, dense, dense_t, embedding,
 )
 from llmss_tpu.ops.rope import apply_rope, sin_cos_tables
-from llmss_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
+from llmss_tpu.parallel.mesh import (
+    AXIS_DP, AXIS_SP, AXIS_TP, shard_map as compat_shard_map,
+)
 from llmss_tpu.parallel.sharding import constrain
 
 
@@ -384,7 +386,7 @@ def _make_decode_kernel_attn(cfg, mesh, cache, positions, slots):
             interpret=interp,
         )
 
-    sharded = jax.shard_map(
+    sharded = compat_shard_map(
         local, mesh=mesh,
         in_specs=(qs, ks, ks, kns, kns, ps, ps, ps, P()),
         out_specs=qs, check_vma=False,
@@ -432,7 +434,7 @@ def _make_sp_decode_attn(cfg, mesh, cache, positions, slots):
             scale=cfg.attn_scale, window=cfg.sliding_window,
         )
 
-    sharded = jax.shard_map(
+    sharded = compat_shard_map(
         local, mesh=mesh,
         in_specs=(qs, ks, ks, ps, P(AXIS_DP, AXIS_SP), kns, kns, ps),
         out_specs=qs, check_vma=False,

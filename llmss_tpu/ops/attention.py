@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from llmss_tpu.parallel.mesh import shard_map as compat_shard_map
+
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 # Attention implementation override: "xla" | "pallas" | "ring" | None (auto).
@@ -383,7 +385,7 @@ def dispatch_attention(
                 return fn(q, k, v, qp, kvp, axis_name=AXIS_SP, scale=scale,
                           window=window)
 
-            return jax.shard_map(
+            return compat_shard_map(
                 local_sp, mesh=mesh,
                 in_specs=(qs, ks, ks, P(AXIS_DP, q_seq_ax),
                           P(AXIS_DP, AXIS_SP)),
@@ -409,7 +411,7 @@ def dispatch_attention(
                     interpret=interp,
                 )
 
-            return jax.shard_map(
+            return compat_shard_map(
                 local, mesh=mesh, in_specs=(qs, ks, ks, ps, ps),
                 out_specs=qs, check_vma=False,
             )(q, k, v, q_positions, kv_positions)

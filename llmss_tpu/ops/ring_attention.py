@@ -110,7 +110,12 @@ def ring_attention(
     D = q.shape[-1]
     if scale is None:
         scale = 1.0 / (D**0.5)
-    sp = lax.axis_size(axis_name)
+    # lax.axis_size is JAX 0.5+; psum of a literal 1 is the pre-0.5 idiom
+    # and constant-folds to the same static int.
+    sp = (
+        lax.axis_size(axis_name) if hasattr(lax, "axis_size")
+        else lax.psum(1, axis_name)
+    )
     m, l, acc = _init_state(q, k.shape[2])
     perm = [(i, (i + 1) % sp) for i in range(sp)]
     for step in range(sp):

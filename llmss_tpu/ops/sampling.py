@@ -24,6 +24,14 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+# The per-row key derivation below assumes the partitionable threefry
+# key semantics (the default from jax 0.5). On older runtimes the legacy
+# non-partitionable streams produce different draws for the same
+# (seed, counter), breaking the cross-mode reproducibility promised in
+# the docstring — so pin the flag explicitly rather than inheriting a
+# version-dependent default.
+jax.config.update("jax_threefry_partitionable", True)
+
 # Static candidate-set size for the fast top-k/top-p path: covers every
 # practical warper (HF's top_k default is 50) while keeping the partial
 # selection ~500x narrower than the 32k-vocab sort it replaces. Rows whose

@@ -16,6 +16,7 @@ from llmss_tpu.parallel.mesh import (
     default_compute_dtype,
     initialize_runtime,
     make_mesh,
+    shard_map,
 )
 
 __all__ = [
@@ -26,4 +27,5 @@ __all__ = [
     "default_compute_dtype",
     "initialize_runtime",
     "make_mesh",
+    "shard_map",
 ]

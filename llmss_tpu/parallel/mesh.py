@@ -140,14 +140,37 @@ def make_mesh(
     # propagates and inserts collectives. (JAX 0.9's default is the new
     # Explicit sharding-in-types mode, which requires per-op out_sharding
     # annotations; Auto is the mature path MaxText-class frameworks use.)
-    auto = (jax.sharding.AxisType.Auto,) * len(AXIS_ORDER)
+    # Older JAX (< 0.5) predates AxisType entirely — Auto is its only
+    # mode, so simply omit the kwarg there instead of crashing at import.
+    axis_type_kw: dict = {}
+    if hasattr(jax.sharding, "AxisType"):
+        axis_type_kw["axis_types"] = (
+            jax.sharding.AxisType.Auto,
+        ) * len(AXIS_ORDER)
     if devices is None:
         devices = jax.devices()
         dp, sp, tp = plan.resolve(len(devices))
-        return jax.make_mesh((dp, sp, tp), AXIS_ORDER, axis_types=auto)
+        return jax.make_mesh((dp, sp, tp), AXIS_ORDER, **axis_type_kw)
     dp, sp, tp = plan.resolve(len(devices))
     arr = np.asarray(devices, dtype=object).reshape(dp, sp, tp)
-    return Mesh(arr, AXIS_ORDER, axis_types=auto)
+    return Mesh(arr, AXIS_ORDER, **axis_type_kw)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-portable ``shard_map``: ``jax.shard_map`` (0.5+, ``check_vma``)
+    or ``jax.experimental.shard_map`` (0.4.x, where the same knob is spelled
+    ``check_rep``)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
 
 
 def default_compute_dtype() -> jnp.dtype:

@@ -21,6 +21,14 @@ defects fixed (SURVEY.md §2.10):
 Broker backends: ``InProcBroker`` (stdlib queues — testing and single-process
 serving) and ``RedisBroker`` (wire-compatible with the reference's Redis
 list queues ``pqueue``/``squeue``; requires the optional ``redis`` package).
+
+Delivery is **at-least-once + idempotent-by-id** (broker.py docstring):
+``pop_request`` is a lease with a visibility timeout, ``push_response``
+acks it, expired leases are redelivered with a delivery-attempt budget
+(then dead-lettered — ``GET /dlq``), requests carry end-to-end deadlines,
+and the producer sheds with 429 + Retry-After when the backlog is full.
+Fault-injection machinery to exercise all of this lives in
+``serve.chaos`` / ``tools/chaos_serve.py``.
 """
 
 from llmss_tpu.serve.broker import Broker, InProcBroker, RedisBroker

@@ -1,4 +1,4 @@
-"""Brokers: request queue + id-correlated response delivery.
+"""Brokers: request queue + id-correlated response delivery, at-least-once.
 
 The reference's broker is a pair of Redis lists — requests ``lpush``-ed onto
 ``pqueue`` (``producer_server.py:47-48``), responses onto ``squeue``
@@ -6,6 +6,30 @@ The reference's broker is a pair of Redis lists — requests ``lpush``-ed onto
 taking *any* response (``producer_server.py:50-54``), which mis-delivers under
 concurrency. Both brokers here keep the queue shape but deliver responses by
 request id.
+
+Delivery contract (at-least-once + idempotent-by-id):
+
+- ``pop_request`` is a **lease** with a visibility timeout, not a
+  destructive pop. The worker that holds a lease must either answer the
+  request (``push_response`` acks the lease) or keep the lease fresh
+  (``touch_requests``) while it decodes.
+- A lease that expires un-acked — the worker was OOM-killed, the chip
+  reset, the host vanished — is **redelivered**: the request goes back on
+  the queue with ``delivery_attempts`` incremented. The reaper runs on
+  the consumer poll path (every ``pop_request``), so any live worker
+  recovers a dead one's requests.
+- A request whose lease expires with ``delivery_attempts`` at
+  ``max_delivery_attempts`` is **dead-lettered**: quarantined on the DLQ
+  (``read_dlq`` / producer ``GET /dlq``) and its waiter answered with a
+  terminal error — a poison request that crash-loops workers stops
+  circulating instead of taking the fleet down.
+- A request whose ``deadline_ts`` has passed at redelivery time is shed
+  with a terminal "deadline exceeded" error — nobody is waiting, so
+  requeueing it would be decoding into the void.
+
+Redelivery can duplicate *work* (a slow-but-alive worker may answer after
+its lease was re-served); it never duplicates *responses seen by a
+client* — the response channel is keyed by request id and consumed once.
 """
 
 from __future__ import annotations
@@ -19,6 +43,15 @@ from llmss_tpu.serve.protocol import GenerateRequest, GenerateResponse
 
 
 class Broker(abc.ABC):
+    # Lease visibility timeout: an un-acked, un-touched lease older than
+    # this is considered abandoned (its worker presumed dead) and its
+    # request is redelivered. Workers touch their leases once per decode
+    # chunk, so the timeout only has to cover one chunk plus slack, not a
+    # whole generation. Constructors override per-instance.
+    lease_s = 60.0
+    # Deliveries (= leases) a request gets before it is dead-lettered.
+    max_delivery_attempts = 3
+
     @abc.abstractmethod
     def push_request(self, req: GenerateRequest) -> None: ...
 
@@ -32,6 +65,51 @@ class Broker(abc.ABC):
     def wait_response(
         self, request_id: str, timeout: float = 60.0
     ) -> GenerateResponse | None: ...
+
+    # -- at-least-once delivery (lease/ack) ---------------------------------
+    # Defaults are no-ops so minimal Broker implementations (tests, custom
+    # backends) keep working with destructive-pop semantics.
+
+    def touch_requests(self, request_ids) -> None:  # noqa: B027
+        """Renew the visibility timeout on leases this worker holds —
+        called once per decode chunk so a long generation is never
+        mistaken for a dead worker."""
+
+    def reap_expired(self) -> int:
+        """Redeliver / dead-letter / deadline-shed expired leases.
+
+        Runs automatically at the top of every ``pop_request``, so any
+        polling worker recovers requests a dead worker took with it.
+        Returns the number of leases reaped."""
+        return 0
+
+    def queue_depth(self) -> int:
+        """Requests waiting in the queue (not counting leased in-flight
+        ones) — the producer's admission-control signal."""
+        return 0
+
+    def dlq_depth(self) -> int:
+        return 0
+
+    def read_dlq(self, limit: int = 100) -> list[dict]:
+        """Most recent dead-lettered requests, as plain dicts."""
+        return []
+
+    def delivery_stats(self) -> dict:
+        """Queue/lease/DLQ depths and redelivery counters (for
+        ``GET /metrics``)."""
+        return {}
+
+    def _expiry_disposition(self, req: GenerateRequest) -> str:
+        """Policy for a lease that timed out un-acked:
+        ``'expired'`` (end-to-end deadline passed — shed),
+        ``'dead-letter'`` (attempts exhausted — quarantine), or
+        ``'requeue'`` (redeliver)."""
+        if req.deadline_ts is not None and time.time() > req.deadline_ts:
+            return "expired"
+        if req.delivery_attempts >= self.max_delivery_attempts:
+            return "dead-letter"
+        return "requeue"
 
     # Cancellation channel: the producer flags ids whose clients have gone
     # away (timeout / explicit cancel); workers query the flags for the ids
@@ -98,9 +176,26 @@ class Broker(abc.ABC):
 class InProcBroker(Broker):
     """stdlib-queue broker for tests and single-process serving."""
 
-    def __init__(self):
+    def __init__(
+        self,
+        *,
+        lease_s: float | None = None,
+        max_delivery_attempts: int | None = None,
+        response_ttl_s: float | None = None,
+    ):
+        if lease_s is not None:
+            self.lease_s = lease_s
+        if max_delivery_attempts is not None:
+            self.max_delivery_attempts = max_delivery_attempts
+        # Responses nobody collects (the client timed out before
+        # wait_response) age out like the cancel/tombstone maps — without
+        # a TTL they leak forever in a long-lived producer.
+        self.response_ttl_s = (
+            response_ttl_s if response_ttl_s is not None else self.CANCEL_TTL_S
+        )
         self._requests: queue.Queue[GenerateRequest] = queue.Queue()
         self._responses: dict[str, GenerateResponse] = {}
+        self._response_expiry: dict[str, float] = {}
         self._cond = threading.Condition()
         self._metrics: dict = {}
         self._cancels: dict[str, float] = {}  # id -> flag deadline
@@ -108,6 +203,12 @@ class InProcBroker(Broker):
         self._streams: dict[str, queue.Queue] = {}
         self._dead_streams: dict[str, float] = {}  # id -> tombstone expiry
         self._stream_lock = threading.Lock()
+        self._leases: dict[str, tuple[float, GenerateRequest]] = {}
+        self._lease_lock = threading.Lock()
+        self._dlq: list[GenerateRequest] = []
+        self._delivery_counts = {
+            "redelivered": 0, "dead_lettered": 0, "deadline_expired": 0,
+        }
 
     def push_stream(self, request_id: str, token_ids: list[int]) -> None:
         with self._stream_lock:
@@ -120,6 +221,11 @@ class InProcBroker(Broker):
         self, request_id: str, timeout: float = 0.0
     ) -> list[int] | None:
         with self._stream_lock:
+            if request_id in self._dead_streams:
+                # A dropped stream must stay dropped: setdefault here would
+                # resurrect the queue the tombstone exists to prevent and
+                # re-leak it.
+                return None
             q = self._streams.setdefault(request_id, queue.Queue())
         try:
             return q.get(timeout=timeout) if timeout else q.get_nowait()
@@ -160,16 +266,96 @@ class InProcBroker(Broker):
         self._requests.put(req)
 
     def pop_request(self, timeout: float = 0.0) -> GenerateRequest | None:
+        self.reap_expired()
         try:
-            return self._requests.get(timeout=timeout) if timeout else (
+            req = self._requests.get(timeout=timeout) if timeout else (
                 self._requests.get_nowait()
             )
         except queue.Empty:
             return None
+        req.delivery_attempts += 1
+        with self._lease_lock:
+            self._leases[req.id] = (time.monotonic() + self.lease_s, req)
+        return req
+
+    def touch_requests(self, request_ids) -> None:
+        now = time.monotonic()
+        with self._lease_lock:
+            for rid in request_ids:
+                held = self._leases.get(rid)
+                if held is not None:
+                    self._leases[rid] = (now + self.lease_s, held[1])
+
+    def reap_expired(self) -> int:
+        now = time.monotonic()
+        with self._lease_lock:
+            dead = [
+                (rid, req) for rid, (t, req) in self._leases.items()
+                if t <= now
+            ]
+            for rid, _ in dead:
+                del self._leases[rid]
+        for _rid, req in dead:
+            disp = self._expiry_disposition(req)
+            if disp == "expired":
+                with self._lease_lock:
+                    self._delivery_counts["deadline_expired"] += 1
+                self.push_response(GenerateResponse(
+                    id=req.id, error="deadline exceeded before completion",
+                ))
+            elif disp == "dead-letter":
+                with self._lease_lock:
+                    self._delivery_counts["dead_lettered"] += 1
+                    self._dlq.append(req)
+                self.push_response(GenerateResponse(
+                    id=req.id,
+                    error=(
+                        f"dead-lettered after {req.delivery_attempts} "
+                        "delivery attempts"
+                    ),
+                ))
+            else:
+                with self._lease_lock:
+                    self._delivery_counts["redelivered"] += 1
+                self._requests.put(req)
+        return len(dead)
+
+    def queue_depth(self) -> int:
+        return self._requests.qsize()
+
+    def dlq_depth(self) -> int:
+        with self._lease_lock:
+            return len(self._dlq)
+
+    def read_dlq(self, limit: int = 100) -> list[dict]:
+        import dataclasses
+
+        with self._lease_lock:
+            recent = self._dlq[-limit:][::-1]  # newest first, like Redis
+        return [dataclasses.asdict(r) for r in recent]
+
+    def delivery_stats(self) -> dict:
+        with self._lease_lock:
+            return {
+                "queue_depth": self._requests.qsize(),
+                "inflight": len(self._leases),
+                "dlq_depth": len(self._dlq),
+                **self._delivery_counts,
+            }
 
     def push_response(self, resp: GenerateResponse) -> None:
+        # Terminal response = ack: the lease is settled, never redelivered.
+        with self._lease_lock:
+            self._leases.pop(resp.id, None)
+        now = time.monotonic()
         with self._cond:
+            for rid in [
+                r for r, t in self._response_expiry.items() if t <= now
+            ]:
+                del self._response_expiry[rid]
+                self._responses.pop(rid, None)
             self._responses[resp.id] = resp
+            self._response_expiry[resp.id] = now + self.response_ttl_s
             self._cond.notify_all()
 
     def wait_response(
@@ -182,6 +368,7 @@ class InProcBroker(Broker):
                 if remaining <= 0:
                     return None
                 self._cond.wait(remaining)
+            self._response_expiry.pop(request_id, None)
             return self._responses.pop(request_id)
 
 
@@ -195,17 +382,146 @@ class RedisBroker(Broker):
     ``producer_server.py:47-48``); responses go to per-request keys
     ``squeue:{id}`` (BLPOP-able) instead of one shared ``squeue``, fixing the
     mis-delivery race while staying in plain Redis list primitives.
+
+    Leases are per-worker keys ``{pqueue}:lease:{worker_id}:{request_id}``
+    holding ``{expires_at, req}`` JSON; the reaper (run on every
+    ``pop_request``) SCANs them, and claims an expired one by being the
+    caller whose DELETE returns 1 — a plain-primitive claim that is safe
+    with any number of concurrent reapers. The key carries a long TTL as a
+    GC backstop only; redelivery is driven by the embedded ``expires_at``.
+    (There is a small pop→lease-write window in which a worker death loses
+    the request until the producer's client timeout; closing it needs
+    LMOVE-style atomic claim, which is noted as future work in
+    docs/serving.md.)
+
+    ``client`` injects a Redis-compatible object (tests use
+    ``serve.chaos.FakeRedis``); when omitted the real ``redis`` package is
+    imported lazily so it stays an optional dependency.
     """
 
     def __init__(self, host: str = "localhost", port: int = 6379,
                  request_queue: str = "pqueue", response_prefix: str = "squeue",
-                 cancel_prefix: str = "cancelled"):
-        import redis  # gated: optional dependency
+                 cancel_prefix: str = "cancelled", *, client=None,
+                 worker_id: str | None = None, lease_s: float | None = None,
+                 max_delivery_attempts: int | None = None):
+        if client is None:
+            import redis  # gated: optional dependency
 
-        self._r = redis.Redis(host=host, port=port)
+            client = redis.Redis(host=host, port=port)
+        self._r = client
         self._rq = request_queue
         self._prefix = response_prefix
         self._cancel_prefix = cancel_prefix
+        if lease_s is not None:
+            self.lease_s = lease_s
+        if max_delivery_attempts is not None:
+            self.max_delivery_attempts = max_delivery_attempts
+        import uuid
+
+        self._worker_id = worker_id or uuid.uuid4().hex[:8]
+        self._lease_prefix = f"{request_queue}:lease"
+        self._dlq_key = f"{request_queue}:dlq"
+        self._stats_prefix = f"{request_queue}:stats"
+
+    # -- lease plumbing -----------------------------------------------------
+
+    def _lease_key(self, request_id: str) -> str:
+        return f"{self._lease_prefix}:{self._worker_id}:{request_id}"
+
+    def _lease_ttl(self) -> int:
+        # GC backstop only — far beyond any live lease, so an orphaned key
+        # cannot survive forever even if no reaper ever runs again.
+        return max(3600, int(self.lease_s * 20))
+
+    def _write_lease(self, req: GenerateRequest) -> None:
+        import json
+
+        self._r.set(
+            self._lease_key(req.id),
+            json.dumps({
+                "expires_at": time.time() + self.lease_s,
+                "req": req.to_json(),
+            }),
+            ex=self._lease_ttl(),
+        )
+
+    def touch_requests(self, request_ids) -> None:
+        import json
+
+        for rid in request_ids:
+            key = self._lease_key(rid)
+            raw = self._r.get(key)
+            if raw is None:
+                continue
+            entry = json.loads(raw)
+            entry["expires_at"] = time.time() + self.lease_s
+            self._r.set(key, json.dumps(entry), ex=self._lease_ttl())
+
+    def reap_expired(self) -> int:
+        import json
+
+        now = time.time()
+        n = 0
+        for key in list(self._r.scan_iter(match=f"{self._lease_prefix}:*")):
+            raw = self._r.get(key)
+            if raw is None:
+                continue
+            entry = json.loads(raw)
+            if entry["expires_at"] > now:
+                continue
+            if not self._r.delete(key):
+                continue  # another reaper claimed this lease
+            req = GenerateRequest.from_json(entry["req"])
+            disp = self._expiry_disposition(req)
+            if disp == "expired":
+                self._r.incr(f"{self._stats_prefix}:deadline_expired")
+                self.push_response(GenerateResponse(
+                    id=req.id, error="deadline exceeded before completion",
+                ))
+            elif disp == "dead-letter":
+                self._r.incr(f"{self._stats_prefix}:dead_lettered")
+                self._r.lpush(self._dlq_key, req.to_json())
+                self.push_response(GenerateResponse(
+                    id=req.id,
+                    error=(
+                        f"dead-lettered after {req.delivery_attempts} "
+                        "delivery attempts"
+                    ),
+                ))
+            else:
+                self._r.incr(f"{self._stats_prefix}:redelivered")
+                # RPUSH: the pop side RPOPs, so a redelivered (oldest)
+                # request goes to the head of the service order.
+                self._r.rpush(self._rq, req.to_json())
+            n += 1
+        return n
+
+    def queue_depth(self) -> int:
+        return int(self._r.llen(self._rq))
+
+    def dlq_depth(self) -> int:
+        return int(self._r.llen(self._dlq_key))
+
+    def read_dlq(self, limit: int = 100) -> list[dict]:
+        import json
+
+        return [
+            json.loads(raw)
+            for raw in self._r.lrange(self._dlq_key, 0, limit - 1)
+        ]
+
+    def delivery_stats(self) -> dict:
+        names = ("redelivered", "dead_lettered", "deadline_expired")
+        vals = self._r.mget([f"{self._stats_prefix}:{k}" for k in names])
+        inflight = sum(
+            1 for _ in self._r.scan_iter(match=f"{self._lease_prefix}:*")
+        )
+        return {
+            "queue_depth": self.queue_depth(),
+            "inflight": inflight,
+            "dlq_depth": self.dlq_depth(),
+            **{k: int(v or 0) for k, v in zip(names, vals)},
+        }
 
     def push_stream(self, request_id: str, token_ids: list[int]) -> None:
         import json
@@ -249,14 +565,25 @@ class RedisBroker(Broker):
         self._r.lpush(self._rq, req.to_json())
 
     def pop_request(self, timeout: float = 0.0) -> GenerateRequest | None:
+        # Lazy reaper: any live worker popping work also recovers expired
+        # leases (including a dead worker's) — no dedicated reaper process.
+        self.reap_expired()
         if timeout:
             item = self._r.brpop(self._rq, timeout=timeout)
             payload = item[1] if item else None
         else:
             payload = self._r.rpop(self._rq)
-        return GenerateRequest.from_json(payload) if payload else None
+        if not payload:
+            return None
+        req = GenerateRequest.from_json(payload)
+        req.delivery_attempts += 1
+        self._write_lease(req)
+        return req
 
     def push_response(self, resp: GenerateResponse) -> None:
+        # Terminal response == ack: release the lease so the reaper never
+        # redelivers completed work.
+        self._r.delete(self._lease_key(resp.id))
         key = f"{self._prefix}:{resp.id}"
         self._r.lpush(key, resp.to_json())
         self._r.expire(key, 600)

@@ -1,0 +1,382 @@
+"""Fault injection for the serving stack's delivery substrate.
+
+The lease/ack contract in ``serve/broker.py`` exists to survive *hard*
+worker death — OOM kill, SIGKILL, chip reset — where no in-process cleanup
+(Supervisor abort, per-batch containment) ever runs. This module provides
+the machinery to actually exercise that regime under a seeded, reproducible
+schedule, both in tests (``tests/test_chaos.py``) and from the command line
+(``tools/chaos_serve.py``):
+
+- ``HardKill`` / ``ChaosWorkerHost``: simulated machine-level worker death.
+  ``HardKill`` derives from ``BaseException`` precisely so it sails through
+  every ``except Exception`` containment layer (the Worker's per-batch
+  containment, the Supervisor's crash handling) — exactly like a real
+  SIGKILL, the worker gets no chance to answer or abort anything.
+- ``ChaosBroker``: proxy around any broker that drops responses, fails
+  pops, delays acks, and injects kills right after a lease is taken.
+- ``FakeRedis``: in-memory stand-in for ``redis.Redis`` covering exactly
+  the primitives ``RedisBroker`` uses, so the Redis delivery path (lease
+  keys, reaper claims, DLQ lists) runs in tests and tools with no server.
+- ``ScriptedEngine``: deterministic no-device engine stand-in — token ``k``
+  for prompt ``p`` is ``(p[-1] + k + 1) % 50257`` — so delivery tests can
+  assert exact payloads across kills and redeliveries, and a prompt
+  containing ``POISON_TOKEN`` can model an input that reliably resets the
+  chip.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import logging
+import random
+import threading
+import time
+from typing import Callable
+
+from llmss_tpu.utils.metrics import EngineMetrics
+
+logger = logging.getLogger("llmss_tpu.serve")
+
+# A prompt containing this token id "crashes the chip" when the scripted
+# engine runs with kill_on_poison=True.
+POISON_TOKEN = 666_000
+
+
+class HardKill(BaseException):
+    """Simulated machine-level worker death (OOM killer / SIGKILL / chip
+    reset). BaseException, not Exception: it must escape the worker's and
+    supervisor's crash containment the way a real SIGKILL would — no error
+    responses, no in-flight abort, leases simply left to expire."""
+
+
+class ChaosWorkerHost:
+    """One simulated worker machine.
+
+    Builds a worker from the factory and loops ``run_once``; an escaping
+    ``HardKill`` is instant death — the worker object is abandoned with no
+    abort path (its leased requests are recovered only by broker
+    redelivery) and a fresh worker is spawned after ``respawn_delay_s``.
+    Any ordinary ``Exception`` is a harness bug: recorded and re-raised so
+    tests fail loudly instead of spinning.
+    """
+
+    def __init__(self, worker_factory: Callable[[], object], *,
+                 respawn_delay_s: float = 0.05):
+        self.worker_factory = worker_factory
+        self.respawn_delay_s = respawn_delay_s
+        self.kills = 0
+        self.spawns = 0
+        self.error: str | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                worker = self.worker_factory()
+                self.spawns += 1
+                while not self._stop.is_set():
+                    worker.run_once()
+            except HardKill as e:
+                self.kills += 1
+                logger.debug("chaos host: worker hard-killed (%s)", e)
+                if self._stop.wait(self.respawn_delay_s):
+                    return
+            except Exception as e:  # noqa: BLE001 — surface harness bugs
+                self.error = f"{type(e).__name__}: {e}"
+                logger.exception("chaos host: unexpected worker error")
+                raise
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
+class ChaosBroker:
+    """Seeded fault-injecting proxy around a real broker.
+
+    Each fault is an independent Bernoulli draw from one ``random.Random``
+    seeded at construction, so a chaos schedule is reproducible from its
+    seed. Faults:
+
+    - ``kill_after_pop_prob``: raise ``HardKill`` *after* a successful
+      ``pop_request`` — the request is leased but its worker dies before
+      doing any work (the SIGKILL-right-after-take window).
+    - ``drop_response_prob``: silently discard a ``push_response`` — the
+      terminal response is lost AND the lease stays un-acked, so only
+      redelivery can still answer the client.
+    - ``pop_fail_prob``: ``pop_request`` returns None without consulting
+      the inner broker (a dropped broker operation).
+    - ``ack_delay_s``: sleep before every delivered ``push_response``
+      (slow-ack window: widens the race between a slow worker answering
+      and the reaper redelivering).
+
+    Everything else delegates to the wrapped broker. Not for use under a
+    ``Supervisor`` (its ``metrics_extra`` hook would land on the proxy, not
+    the inner broker) — chaos runs use ``ChaosWorkerHost`` instead, which
+    models the harder failure mode anyway.
+    """
+
+    def __init__(self, inner, *, seed: int = 0,
+                 kill_after_pop_prob: float = 0.0,
+                 drop_response_prob: float = 0.0,
+                 pop_fail_prob: float = 0.0,
+                 ack_delay_s: float = 0.0):
+        self.inner = inner
+        self.kill_after_pop_prob = kill_after_pop_prob
+        self.drop_response_prob = drop_response_prob
+        self.pop_fail_prob = pop_fail_prob
+        self.ack_delay_s = ack_delay_s
+        self._rng = random.Random(seed)
+        self.faults = {"kills": 0, "dropped_responses": 0, "dropped_pops": 0}
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def pop_request(self, timeout: float = 0.0):
+        if self.pop_fail_prob and self._rng.random() < self.pop_fail_prob:
+            self.faults["dropped_pops"] += 1
+            return None
+        req = self.inner.pop_request(timeout)
+        if (
+            req is not None
+            and self.kill_after_pop_prob
+            and self._rng.random() < self.kill_after_pop_prob
+        ):
+            self.faults["kills"] += 1
+            raise HardKill(f"chaos: killed holding lease on {req.id}")
+        return req
+
+    def push_response(self, resp) -> None:
+        if self.ack_delay_s:
+            time.sleep(self.ack_delay_s)
+        if (
+            self.drop_response_prob
+            and self._rng.random() < self.drop_response_prob
+        ):
+            self.faults["dropped_responses"] += 1
+            return
+        self.inner.push_response(resp)
+
+
+class ScriptedEngine:
+    """Deterministic engine stand-in (no JAX, no device) for delivery-layer
+    fault injection: implements exactly the surface ``serve.consumer.Worker``
+    uses. Token ``k`` of the continuation for prompt ``p`` is
+    ``(p[-1] + k + 1) % 50257``, so a test can predict every payload.
+
+    With ``kill_on_poison=True``, a batch containing ``POISON_TOKEN``
+    raises ``HardKill`` mid-generate — a request that deterministically
+    takes down whichever worker leases it.
+    """
+
+    def __init__(self, *, kill_on_poison: bool = False,
+                 chunk_delay_s: float = 0.0):
+        self.kill_on_poison = kill_on_poison
+        self.chunk_delay_s = chunk_delay_s
+        self.metrics = EngineMetrics()
+        self.generate_calls = 0
+        self.max_seq_len = 4096
+
+    def prewarm(self, *args, **kwargs) -> int:
+        return 0
+
+    def check_capacity(self, prompt_len: int, max_new_tokens: int) -> None:
+        if prompt_len + max_new_tokens > self.max_seq_len:
+            raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+
+    @staticmethod
+    def expected_tokens(prompt: list[int], max_new_tokens: int) -> list[int]:
+        return [(prompt[-1] + k + 1) % 50257 for k in range(max_new_tokens)]
+
+    def generate(self, prompts, gens, cancel_poll=None, on_increment=None,
+                 chunk_steps: int = 8, live_rows: int | None = None):
+        self.generate_calls += 1
+        n_live = len(prompts) if live_rows is None else live_rows
+        if self.kill_on_poison and any(
+            POISON_TOKEN in p for p in prompts[:n_live]
+        ):
+            raise HardKill("poison request: simulated chip reset")
+        outs = [
+            self.expected_tokens(p, g.max_new_tokens)
+            for p, g in zip(prompts, gens)
+        ]
+        steps = max(g.max_new_tokens for g in gens) if gens else 0
+        for start in range(0, steps, max(chunk_steps, 1)):
+            if self.chunk_delay_s:
+                time.sleep(self.chunk_delay_s)
+            if cancel_poll is not None:
+                cancel_poll()
+            if on_increment is not None:
+                for row in range(n_live):
+                    inc = outs[row][start:start + chunk_steps]
+                    if inc:
+                        on_increment(row, inc)
+        self.metrics.add_request(n_live)
+        self.metrics.add_tokens(sum(len(t) for t in outs[:n_live]))
+        return [list(t) for t in outs]
+
+
+class FakeRedis:
+    """Minimal in-memory ``redis.Redis`` stand-in: exactly the primitives
+    ``RedisBroker`` uses (string get/set/mget/delete/expire/incr, list
+    lpush/rpush/rpop/brpop/llen/lrange, scan_iter), bytes-returning like a
+    real client with ``decode_responses=False``, with lazy TTL expiry.
+    Thread-safe; ``brpop`` blocks on a condition variable."""
+
+    def __init__(self):
+        self._data: dict[str, object] = {}
+        self._expiry: dict[str, float] = {}
+        self._cond = threading.Condition()
+
+    @staticmethod
+    def _k(key) -> str:
+        return key.decode() if isinstance(key, bytes) else str(key)
+
+    @staticmethod
+    def _b(value) -> bytes:
+        return value if isinstance(value, bytes) else str(value).encode()
+
+    def _live(self, key: str):
+        """Value for ``key`` with lazy TTL purge. Caller holds the lock."""
+        exp = self._expiry.get(key)
+        if exp is not None and exp <= time.monotonic():
+            self._data.pop(key, None)
+            self._expiry.pop(key, None)
+        return self._data.get(key)
+
+    # -- strings ------------------------------------------------------------
+
+    def set(self, key, value, ex=None):
+        key = self._k(key)
+        with self._cond:
+            self._data[key] = self._b(value)
+            if ex is not None:
+                self._expiry[key] = time.monotonic() + ex
+            else:
+                self._expiry.pop(key, None)
+            self._cond.notify_all()
+        return True
+
+    def get(self, key):
+        with self._cond:
+            v = self._live(self._k(key))
+        return v if isinstance(v, bytes) else None
+
+    def mget(self, keys):
+        with self._cond:
+            vals = [self._live(self._k(k)) for k in keys]
+        return [v if isinstance(v, bytes) else None for v in vals]
+
+    def delete(self, *keys):
+        n = 0
+        with self._cond:
+            for key in keys:
+                key = self._k(key)
+                if self._live(key) is not None:
+                    del self._data[key]
+                    self._expiry.pop(key, None)
+                    n += 1
+        return n
+
+    def expire(self, key, seconds):
+        key = self._k(key)
+        with self._cond:
+            if self._live(key) is None:
+                return False
+            self._expiry[key] = time.monotonic() + seconds
+        return True
+
+    def incr(self, key):
+        key = self._k(key)
+        with self._cond:
+            v = self._live(key)
+            n = int(v) + 1 if v is not None else 1
+            self._data[key] = str(n).encode()
+        return n
+
+    # -- lists --------------------------------------------------------------
+
+    def _list(self, key: str) -> list:
+        lst = self._live(key)
+        if lst is None:
+            lst = []
+            self._data[key] = lst
+        return lst
+
+    def _drop_if_empty(self, key: str) -> None:
+        if not self._data.get(key):
+            self._data.pop(key, None)
+            self._expiry.pop(key, None)
+
+    def lpush(self, key, *values):
+        key = self._k(key)
+        with self._cond:
+            lst = self._list(key)
+            for v in values:
+                lst.insert(0, self._b(v))
+            self._cond.notify_all()
+            return len(lst)
+
+    def rpush(self, key, *values):
+        key = self._k(key)
+        with self._cond:
+            lst = self._list(key)
+            lst.extend(self._b(v) for v in values)
+            self._cond.notify_all()
+            return len(lst)
+
+    def rpop(self, key):
+        key = self._k(key)
+        with self._cond:
+            lst = self._live(key)
+            if not lst:
+                return None
+            v = lst.pop()
+            self._drop_if_empty(key)
+            return v
+
+    def brpop(self, key, timeout=0):
+        key = self._k(key)
+        # Redis blocks forever on timeout=0; poll in small quanta so lazy
+        # TTL expiry elsewhere can't wedge a waiter.
+        deadline = time.monotonic() + (timeout if timeout else 3650 * 86400)
+        with self._cond:
+            while True:
+                lst = self._live(key)
+                if lst:
+                    v = lst.pop()
+                    self._drop_if_empty(key)
+                    return (key.encode(), v)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cond.wait(min(remaining, 0.05))
+
+    def llen(self, key):
+        with self._cond:
+            lst = self._live(self._k(key))
+            return len(lst) if isinstance(lst, list) else 0
+
+    def lrange(self, key, start, stop):
+        with self._cond:
+            lst = self._live(self._k(key))
+            if not isinstance(lst, list):
+                return []
+            end = None if stop == -1 else stop + 1
+            return list(lst[start:end])
+
+    # -- keyspace -----------------------------------------------------------
+
+    def scan_iter(self, match="*"):
+        with self._cond:
+            keys = [k for k in self._data if fnmatch.fnmatch(k, match)]
+        for key in keys:
+            with self._cond:
+                if self._live(key) is not None:
+                    yield key.encode()

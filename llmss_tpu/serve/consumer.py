@@ -119,11 +119,20 @@ class Worker:
         # a cancel that raced ahead of its request still lands here.
         cancelled = self.broker.check_cancelled([r.id for r in batch])
         prompts, gens, ok = [], [], []
+        now = time.time()
         for req in batch:
             if req.id in cancelled:
                 self.engine.metrics.add_cancelled()
                 self.broker.push_response(
                     GenerateResponse(id=req.id, error="cancelled")
+                )
+                continue
+            if req.deadline_ts is not None and now > req.deadline_ts:
+                # Shed before prefill: the client's end-to-end deadline has
+                # passed, so decoding would be work nobody collects.
+                self.engine.metrics.add_expired()
+                self.broker.push_response(
+                    GenerateResponse(id=req.id, error="deadline exceeded")
                 )
                 continue
             try:
@@ -157,8 +166,11 @@ class Worker:
             # whose clients are gone. Publishing here also keeps the
             # supervisor heartbeat fresh through a long batch (the merge
             # hook stamps heartbeat_ts at publish time) — without it a
-            # multi-thousand-token batch reads as a hung worker.
+            # multi-thousand-token batch reads as a hung worker. Touching
+            # the leases here keeps a long decode from being mistaken for
+            # a dead worker (same cadence, one decode chunk).
             self.broker.publish_metrics(self.engine.metrics.to_dict())
+            self.broker.touch_requests([r.id for r in ok])
             hits = self.broker.check_cancelled(
                 [r.id for r in ok if r.id not in mid_cancelled]
             )
@@ -270,6 +282,16 @@ class ContinuousWorker:
             )
             if req is None:
                 return n
+            if (
+                req.deadline_ts is not None
+                and time.time() > req.deadline_ts
+            ):
+                # Shed before prefill (see Worker.run_once).
+                self.engine.metrics.add_expired()
+                self.broker.push_response(
+                    GenerateResponse(id=req.id, error="deadline exceeded")
+                )
+                continue
             try:
                 req.validate()
                 ids = encode_request(self.tokenizer, req)
@@ -340,7 +362,12 @@ class ContinuousWorker:
         # this batcher holds (pending, in-flight admission, active): the
         # flag persists until its request shows up, so cancel-before-submit
         # races land, and other workers' ids are never swallowed.
-        for rid in self.broker.check_cancelled(self.batcher.live_ids()):
+        live = self.batcher.live_ids()
+        # Renew this worker's leases on everything it holds — pending and
+        # active alike — so only a genuinely dead worker's requests are
+        # redelivered, never a busy one's.
+        self.broker.touch_requests(live)
+        for rid in self.broker.check_cancelled(live):
             # The batcher frees the row at the top of its next step; the
             # request's done_cb fires with the tokens produced so far.
             self.batcher.cancel(rid)
@@ -403,6 +430,17 @@ def main(argv=None):
     parser.add_argument("--redis_host", default="localhost")
     parser.add_argument("--redis_port", type=int, default=6379)
     parser.add_argument(
+        "--lease_s", type=float, default=60.0,
+        help="request lease visibility timeout: an un-acked lease older "
+             "than this is redelivered to another worker (workers renew "
+             "leases every decode chunk)",
+    )
+    parser.add_argument(
+        "--max_delivery_attempts", type=int, default=3,
+        help="deliveries before a request is dead-lettered instead of "
+             "redelivered (poison-request quarantine)",
+    )
+    parser.add_argument(
         "--supervise", action="store_true",
         help="run under the crash-restart supervisor (heartbeats + capped "
              "exponential backoff)",
@@ -427,7 +465,10 @@ def main(argv=None):
         max_seq_len=args.max_seq_len or cfg.max_position_embeddings,
     )
     tokenizer = AutoTokenizer.from_pretrained(args.pretrained_model_path)
-    broker = RedisBroker(args.redis_host, args.redis_port)
+    broker = RedisBroker(
+        args.redis_host, args.redis_port, lease_s=args.lease_s,
+        max_delivery_attempts=args.max_delivery_attempts,
+    )
 
     def make_worker():
         if args.continuous:

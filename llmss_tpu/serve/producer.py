@@ -24,9 +24,14 @@ class ProducerServer:
     HEARTBEAT_STALE_FACTOR = 3.0
 
     def __init__(self, broker: Broker, host: str = "0.0.0.0",
-                 port: int = 8000, timeout_s: float = 300.0):
+                 port: int = 8000, timeout_s: float = 300.0,
+                 max_queue_depth: int = 1024):
         self.broker = broker
         self.timeout_s = timeout_s
+        # Admission control: when the broker backlog reaches this depth,
+        # /generate sheds with 429 + Retry-After instead of queueing work
+        # that will blow its deadline anyway (0 disables).
+        self.max_queue_depth = max_queue_depth
         self._saw_supervisor = False
         outer = self
 
@@ -47,9 +52,46 @@ class ProducerServer:
                     code, body = outer.health()
                     self._reply(code, body)
                 elif self.path == "/metrics":
-                    self._reply(200, outer.broker.read_metrics())
+                    self._reply(200, {
+                        **outer.broker.read_metrics(),
+                        "delivery": outer.broker.delivery_stats(),
+                    })
+                elif self.path == "/dlq":
+                    # Admin surface for quarantined poison requests: depth
+                    # plus the most recent dead-lettered payloads.
+                    self._reply(200, {
+                        "depth": outer.broker.dlq_depth(),
+                        "requests": outer.broker.read_dlq(),
+                    })
                 else:
                     self._reply(404, {"error": "not found"})
+
+            def _admit(self, req) -> bool:
+                """Admission control + deadline stamping. Returns False
+                (with the 429 already sent) when the backlog is full."""
+                if (
+                    outer.max_queue_depth
+                    and outer.broker.queue_depth() >= outer.max_queue_depth
+                ):
+                    body = json.dumps({
+                        "error": "queue full", "id": req.id,
+                        "queue_depth": outer.broker.queue_depth(),
+                    }).encode()
+                    self.send_response(429)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Retry-After", "1")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return False
+                if req.deadline_ts is None:
+                    # Every request carries an end-to-end deadline so
+                    # workers can shed expired work before prefill instead
+                    # of decoding into the void.
+                    import time as _time
+
+                    req.deadline_ts = _time.time() + outer.timeout_s
+                return True
 
             def _stream_response(self, req):
                 """SSE delivery for ``stream: true`` requests: one
@@ -139,6 +181,8 @@ class ProducerServer:
                 except Exception as e:  # noqa: BLE001 — client error surface
                     self._reply(400, {"error": str(e)})
                     return
+                if not self._admit(req):
+                    return
                 if req.stream:
                     self._stream_response(req)
                     return
@@ -214,16 +258,18 @@ class ProducerServer:
         self._server.serve_forever()
 
 
-def create_fastapi_app(broker: Broker, timeout_s: float = 300.0):
+def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
+                       max_queue_depth: int = 1024):
     """FastAPI variant of the producer (optional dependency, gated).
 
     Full API parity with ``ProducerServer``: POST /generate (JSON or SSE
-    streaming via ``stream: true``, same event format), POST /cancel,
-    GET /metrics, GET /health."""
+    streaming via ``stream: true``, same event format, 429 + Retry-After
+    admission control, deadline stamping), POST /cancel, GET /metrics,
+    GET /health, GET /dlq."""
     import time as _time
 
     from fastapi import FastAPI, HTTPException
-    from fastapi.responses import StreamingResponse
+    from fastapi.responses import JSONResponse, StreamingResponse
 
     app = FastAPI()
 
@@ -268,6 +314,15 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0):
             req.validate()
         except ValueError as e:
             raise HTTPException(400, str(e)) from e
+        if max_queue_depth and broker.queue_depth() >= max_queue_depth:
+            return JSONResponse(
+                status_code=429,
+                content={"error": "queue full", "id": req.id,
+                         "queue_depth": broker.queue_depth()},
+                headers={"Retry-After": "1"},
+            )
+        if req.deadline_ts is None:
+            req.deadline_ts = _time.time() + timeout_s
         broker.push_request(req)
         if req.stream:
             return StreamingResponse(
@@ -292,7 +347,17 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0):
 
     @app.get("/metrics")
     def metrics():
-        return broker.read_metrics()
+        return {
+            **broker.read_metrics(),
+            "delivery": broker.delivery_stats(),
+        }
+
+    @app.get("/dlq")
+    def dlq():
+        return {
+            "depth": broker.dlq_depth(),
+            "requests": broker.read_dlq(),
+        }
 
     @app.get("/health")
     def health():
@@ -309,12 +374,20 @@ def main(argv=None):
     parser.add_argument("--port", type=int, default=8000)
     parser.add_argument("--redis_host", default="localhost")
     parser.add_argument("--redis_port", type=int, default=6379)
+    parser.add_argument("--timeout_s", type=float, default=300.0,
+                        help="end-to-end request deadline (stamped into "
+                             "deadline_ts at admission)")
+    parser.add_argument("--max_queue_depth", type=int, default=1024,
+                        help="shed with 429 once the broker backlog reaches "
+                             "this depth (0 disables)")
     args = parser.parse_args(argv)
 
     from llmss_tpu.serve.broker import RedisBroker
 
     broker = RedisBroker(args.redis_host, args.redis_port)
-    server = ProducerServer(broker, args.host, args.port)
+    server = ProducerServer(broker, args.host, args.port,
+                            timeout_s=args.timeout_s,
+                            max_queue_depth=args.max_queue_depth)
     print(f"producer listening on {args.host}:{server.port}")
     server.serve_forever()
 

@@ -35,6 +35,17 @@ class GenerateRequest:
     # once. Purely an optimization: workers without prefix support (the
     # batch Worker) ignore it.
     prefix_token_ids: list[int] | None = None
+    # At-least-once delivery bookkeeping (broker-maintained): incremented
+    # on every lease (``pop_request``); when a lease expires with
+    # ``delivery_attempts`` at the broker's max, the request is
+    # dead-lettered instead of redelivered, so a poison request cannot
+    # crash-loop the fleet forever.
+    delivery_attempts: int = 0
+    # End-to-end deadline, epoch seconds (producer-stamped from its
+    # timeout unless the client set one): workers shed expired requests
+    # before prefill, and the broker's lease reaper sheds them at
+    # redelivery time instead of requeueing work nobody is waiting for.
+    deadline_ts: float | None = None
     id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
 
     def to_json(self) -> str:

@@ -46,6 +46,11 @@ class Supervisor:
         self.heartbeat_s = heartbeat_s
         self.restarts = 0
         self.alive = False
+        # Current restart delay. Instance state (not a loop local) so tests
+        # and operators can observe backoff growth/reset; doubles after each
+        # crash, resets to ``backoff_s`` once a worker has run for
+        # ``stable_after_s``.
+        self.backoff_current = backoff_s
         self._last_error: str | None = None
         self._start = time.time()
         # Merged into EVERY broker publish (worker-side ones included), so
@@ -64,6 +69,7 @@ class Supervisor:
             # Published so health consumers (producer /health) can judge
             # staleness without configuration coupling.
             "heartbeat_s": self.heartbeat_s,
+            "backoff_current_s": self.backoff_current,
         }
 
     def _publish(self, worker) -> None:
@@ -94,7 +100,7 @@ class Supervisor:
     def run(self, stop: threading.Event | None = None) -> None:
         """Supervised serving loop; returns when ``stop`` is set, raises
         only when the restart budget is exhausted."""
-        backoff = self.backoff_s
+        self.backoff_current = self.backoff_s
         while stop is None or not stop.is_set():
             worker = None
             started = time.time()
@@ -111,14 +117,15 @@ class Supervisor:
                         self._publish(worker)
                         last_beat = now
                     if now - started > self.stable_after_s:
-                        backoff = self.backoff_s
+                        self.backoff_current = self.backoff_s
             except Exception as e:  # noqa: BLE001 — crash containment
                 self.alive = False
                 self.restarts += 1
                 self._last_error = f"{type(e).__name__}: {e}"
                 logger.error(
                     "worker crashed (%s), restart %d in %.1fs",
-                    self._last_error, self.restarts, backoff, exc_info=True,
+                    self._last_error, self.restarts,
+                    self.backoff_current, exc_info=True,
                 )
                 if worker is not None:
                     self._abort_inflight(worker, self._last_error)
@@ -133,10 +140,12 @@ class Supervisor:
                         f"{self._last_error}"
                     ) from e
                 if stop is not None:
-                    if stop.wait(backoff):
+                    if stop.wait(self.backoff_current):
                         return
                 else:
-                    time.sleep(backoff)
-                backoff = min(backoff * 2, self.backoff_cap_s)
+                    time.sleep(self.backoff_current)
+                self.backoff_current = min(
+                    self.backoff_current * 2, self.backoff_cap_s
+                )
                 continue
             return  # stop was set inside the inner loop

@@ -90,6 +90,7 @@ class EngineMetrics:
         self.requests_served = 0
         self.errors = 0
         self.cancelled = 0
+        self.deadline_expired = 0
         self._start = time.time()
 
     def add_tokens(self, n: int) -> None:
@@ -108,12 +109,18 @@ class EngineMetrics:
         with self._lock:
             self.cancelled += n
 
+    def add_expired(self, n: int = 1) -> None:
+        """Requests shed before prefill because their end-to-end
+        ``deadline_ts`` had already passed."""
+        with self._lock:
+            self.deadline_expired += n
+
     def to_dict(self) -> dict:
         uptime = time.time() - self._start
         with self._lock:
-            toks, reqs, errs, canc = (
+            toks, reqs, errs, canc, exp = (
                 self.tokens_generated, self.requests_served, self.errors,
-                self.cancelled,
+                self.cancelled, self.deadline_expired,
             )
         return {
             "uptime_s": round(uptime, 1),
@@ -121,6 +128,7 @@ class EngineMetrics:
             "tokens_generated": toks,
             "errors": errs,
             "cancelled": canc,
+            "deadline_expired": exp,
             "tokens_per_sec_lifetime": round(toks / uptime, 2) if uptime else 0,
             "ttft": self.ttft.to_dict(),
             "prefill": self.prefill.to_dict(),

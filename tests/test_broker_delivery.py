@@ -1,0 +1,290 @@
+"""At-least-once delivery: leases, redelivery, DLQ, deadlines, admission.
+
+Pins the broker delivery contract (serve/broker.py docstring) on both
+substrates: ``InProcBroker`` directly, and the real ``RedisBroker`` code
+against the in-memory ``FakeRedis`` (serve/chaos.py) — same primitives a
+real server provides, no server required.
+"""
+
+import threading
+import time
+
+import pytest
+
+from llmss_tpu.serve.broker import InProcBroker, RedisBroker
+from llmss_tpu.serve.chaos import FakeRedis, ScriptedEngine
+from llmss_tpu.serve.consumer import Worker
+from llmss_tpu.serve.producer import ProducerServer
+from llmss_tpu.serve.protocol import GenerateRequest, GenerateResponse
+
+
+def make_broker(kind, **kw):
+    if kind == "inproc":
+        return InProcBroker(**kw)
+    return RedisBroker(client=FakeRedis(), worker_id="w0", **kw)
+
+
+BROKERS = ("inproc", "fakeredis")
+
+
+# -- lease / ack ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", BROKERS)
+def test_ack_prevents_redelivery(kind):
+    b = make_broker(kind, lease_s=0.05)
+    b.push_request(GenerateRequest(id="r1", token_ids=[1]))
+    req = b.pop_request()
+    assert req.id == "r1" and req.delivery_attempts == 1
+    b.push_response(GenerateResponse(id="r1", token_ids=[2]))  # ack
+    time.sleep(0.1)  # lease would have expired had it not been acked
+    assert b.reap_expired() == 0
+    assert b.pop_request() is None
+    assert b.wait_response("r1", timeout=1).token_ids == [2]
+
+
+@pytest.mark.parametrize("kind", BROKERS)
+def test_expired_lease_is_redelivered(kind):
+    b = make_broker(kind, lease_s=0.05)
+    b.push_request(GenerateRequest(id="r1", token_ids=[1]))
+    assert b.pop_request().delivery_attempts == 1
+    # Worker dies holding the lease: no ack, no touch.
+    time.sleep(0.1)
+    again = b.pop_request()  # reaper runs here and requeues
+    assert again is not None and again.id == "r1"
+    assert again.delivery_attempts == 2
+    assert b.delivery_stats()["redelivered"] == 1
+
+
+@pytest.mark.parametrize("kind", BROKERS)
+def test_touch_keeps_lease_alive(kind):
+    b = make_broker(kind, lease_s=0.08)
+    b.push_request(GenerateRequest(id="r1", token_ids=[1]))
+    b.pop_request()
+    for _ in range(4):  # a long decode, renewing every chunk
+        time.sleep(0.04)
+        b.touch_requests(["r1"])
+    assert b.reap_expired() == 0
+    assert b.pop_request() is None  # never redelivered
+
+
+@pytest.mark.parametrize("kind", BROKERS)
+def test_dead_letter_after_max_attempts(kind):
+    b = make_broker(kind, lease_s=0.03, max_delivery_attempts=2)
+    b.push_request(GenerateRequest(id="poison", token_ids=[1]))
+    assert b.pop_request().delivery_attempts == 1
+    time.sleep(0.06)
+    assert b.pop_request().delivery_attempts == 2  # redelivery
+    time.sleep(0.06)
+    # Attempts exhausted: quarantined, not requeued.
+    assert b.pop_request() is None
+    assert b.dlq_depth() == 1
+    dlq = b.read_dlq()
+    assert dlq[0]["id"] == "poison" and dlq[0]["delivery_attempts"] == 2
+    # The waiter gets a terminal error, not silence.
+    resp = b.wait_response("poison", timeout=1)
+    assert resp is not None and "dead-lettered after 2" in resp.error
+    stats = b.delivery_stats()
+    assert stats["dead_lettered"] == 1 and stats["dlq_depth"] == 1
+
+
+@pytest.mark.parametrize("kind", BROKERS)
+def test_deadline_shed_at_redelivery(kind):
+    b = make_broker(kind, lease_s=0.03)
+    b.push_request(GenerateRequest(
+        id="late", token_ids=[1], deadline_ts=time.time() + 0.05,
+    ))
+    b.pop_request()
+    time.sleep(0.1)  # lease AND deadline both expired
+    assert b.pop_request() is None  # shed, not redelivered
+    resp = b.wait_response("late", timeout=1)
+    assert resp is not None and "deadline exceeded" in resp.error
+    assert b.delivery_stats()["deadline_expired"] == 1
+
+
+@pytest.mark.parametrize("kind", BROKERS)
+def test_delivery_stats_shape(kind):
+    b = make_broker(kind)
+    b.push_request(GenerateRequest(id="a", token_ids=[1]))
+    b.push_request(GenerateRequest(id="b", token_ids=[1]))
+    assert b.queue_depth() == 2
+    b.pop_request()
+    stats = b.delivery_stats()
+    assert stats["queue_depth"] == 1
+    assert stats["inflight"] == 1
+    assert stats["dlq_depth"] == 0
+    assert stats["redelivered"] == 0
+
+
+def test_cross_worker_redelivery_fakeredis():
+    """A live worker recovers a dead worker's leases (the reaper runs on
+    every pop, whoever the popper is)."""
+    server = FakeRedis()
+    dead = RedisBroker(client=server, worker_id="dead", lease_s=0.05)
+    live = RedisBroker(client=server, worker_id="live", lease_s=0.05)
+    dead.push_request(GenerateRequest(id="r1", token_ids=[1]))
+    assert dead.pop_request().id == "r1"  # then the worker is SIGKILLed
+    time.sleep(0.1)
+    again = live.pop_request()
+    assert again is not None and again.id == "r1"
+    assert again.delivery_attempts == 2
+    # The recovering worker now holds its own lease; its ack settles it.
+    live.push_response(GenerateResponse(id="r1", token_ids=[7]))
+    assert live.reap_expired() == 0
+    assert live.wait_response("r1", timeout=1).token_ids == [7]
+
+
+# -- satellite fixes --------------------------------------------------------
+
+
+def test_inproc_response_ttl_reaps_uncollected():
+    """Responses nobody waits for age out instead of leaking forever."""
+    b = InProcBroker(response_ttl_s=0.01)
+    b.push_response(GenerateResponse(id="orphan", token_ids=[1]))
+    time.sleep(0.03)
+    # Any later push runs the reap pass.
+    b.push_response(GenerateResponse(id="fresh", token_ids=[2]))
+    assert "orphan" not in b._responses
+    assert b.wait_response("orphan", timeout=0.01) is None
+    assert b.wait_response("fresh", timeout=1).token_ids == [2]
+
+
+def test_inproc_dropped_stream_stays_dropped():
+    """pop_stream after drop_stream must not resurrect the tombstoned
+    queue (the leak the tombstone exists to prevent)."""
+    b = InProcBroker()
+    b.push_stream("s1", [1, 2])
+    assert b.pop_stream("s1") == [1, 2]
+    b.drop_stream("s1")
+    assert b.pop_stream("s1") is None
+    assert "s1" not in b._streams  # not resurrected by the pop
+    b.push_stream("s1", [3])  # late worker flush
+    assert "s1" not in b._streams
+    assert b.pop_stream("s1") is None
+
+
+# -- worker integration -----------------------------------------------------
+
+
+def test_worker_sheds_expired_before_prefill():
+    """An already-expired request never reaches the engine."""
+    b = InProcBroker()
+    eng = ScriptedEngine()
+    w = Worker(eng, b, batch_size=2, poll_timeout_s=0.01, pad_batch=False)
+    b.push_request(GenerateRequest(
+        id="stale", token_ids=[5], max_new_tokens=4,
+        deadline_ts=time.time() - 1,
+    ))
+    w.run_once()
+    assert eng.generate_calls == 0
+    assert eng.metrics.deadline_expired == 1
+    resp = b.wait_response("stale", timeout=1)
+    assert resp is not None and "deadline exceeded" in resp.error
+
+
+def test_worker_acks_via_push_response():
+    b = InProcBroker(lease_s=0.05)
+    eng = ScriptedEngine()
+    w = Worker(eng, b, batch_size=2, poll_timeout_s=0.01, pad_batch=False)
+    b.push_request(GenerateRequest(id="ok", token_ids=[5], max_new_tokens=4))
+    w.run_once()
+    resp = b.wait_response("ok", timeout=1)
+    assert resp.token_ids == ScriptedEngine.expected_tokens([5], 4)
+    time.sleep(0.1)
+    assert b.reap_expired() == 0  # settled, nothing to redeliver
+
+
+# -- producer: admission control + admin surface ----------------------------
+
+
+def _post(port, path, payload):
+    import http.client
+    import json
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("POST", path, json.dumps(payload),
+                 {"Content-Type": "application/json"})
+    r = conn.getresponse()
+    body = json.loads(r.read() or b"{}")
+    headers = dict(r.getheaders())
+    conn.close()
+    return r.status, body, headers
+
+
+def _get(port, path):
+    import http.client
+    import json
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = json.loads(r.read() or b"{}")
+    conn.close()
+    return r.status, body
+
+
+def test_producer_sheds_when_queue_full():
+    b = InProcBroker()
+    # Fill the backlog past the admission limit; no worker drains it.
+    b.push_request(GenerateRequest(id="old", token_ids=[1]))
+    srv = ProducerServer(b, host="127.0.0.1", port=0, timeout_s=5.0,
+                         max_queue_depth=1)
+    srv.start()
+    try:
+        status, body, headers = _post(
+            srv.port, "/generate", {"token_ids": [2], "max_new_tokens": 2},
+        )
+        assert status == 429
+        assert body["error"] == "queue full"
+        assert headers.get("Retry-After") == "1"
+        assert b.queue_depth() == 1  # the shed request was never queued
+    finally:
+        srv.stop()
+
+
+def test_producer_stamps_deadline():
+    b = InProcBroker()
+    srv = ProducerServer(b, host="127.0.0.1", port=0, timeout_s=7.0)
+    srv.start()
+    got = {}
+
+    def worker():
+        req = b.pop_request(timeout=5)
+        got["req"] = req
+        b.push_response(GenerateResponse(id=req.id, token_ids=[1]))
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        before = time.time()
+        status, body, _ = _post(
+            srv.port, "/generate", {"token_ids": [2], "max_new_tokens": 2},
+        )
+        assert status == 200
+        t.join(timeout=5)
+        dl = got["req"].deadline_ts
+        assert dl is not None
+        assert before + 5.0 < dl <= time.time() + 7.0
+    finally:
+        srv.stop()
+
+
+def test_producer_dlq_and_delivery_metrics():
+    b = InProcBroker(lease_s=0.02, max_delivery_attempts=1)
+    srv = ProducerServer(b, host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        b.push_request(GenerateRequest(id="p1", token_ids=[1]))
+        b.pop_request()  # leased, worker "dies"
+        time.sleep(0.05)
+        b.reap_expired()  # attempts exhausted -> DLQ
+        status, body = _get(srv.port, "/dlq")
+        assert status == 200
+        assert body["depth"] == 1
+        assert body["requests"][0]["id"] == "p1"
+        status, body = _get(srv.port, "/metrics")
+        assert status == 200
+        assert body["delivery"]["dead_lettered"] == 1
+        assert body["delivery"]["dlq_depth"] == 1
+    finally:
+        srv.stop()

@@ -1,0 +1,226 @@
+"""Fault injection: every accepted request gets exactly one terminal
+response, even when workers are hard-killed mid-batch.
+
+These tests run the real delivery stack (broker leases + Worker loop)
+under ``serve.chaos``: ``HardKill`` escapes every containment layer the
+way a SIGKILL would — the dying worker answers nothing and aborts
+nothing — so only the broker's lease/redelivery machinery can keep the
+at-least-once promise. ``ScriptedEngine`` makes every successful payload
+exactly predictable, so the audit can also catch corruption.
+"""
+
+import threading
+import time
+
+import pytest
+
+from llmss_tpu.serve.broker import InProcBroker, RedisBroker
+from llmss_tpu.serve.chaos import (
+    POISON_TOKEN, ChaosBroker, ChaosWorkerHost, FakeRedis, ScriptedEngine,
+)
+from llmss_tpu.serve.consumer import Worker
+from llmss_tpu.serve.producer import ProducerServer
+from llmss_tpu.serve.protocol import GenerateRequest
+
+
+def _collect(broker, reqs, timeout_s):
+    """One waiter per request (the producer pattern). Returns
+    {id: response|None|'DUPLICATE'}."""
+    results = {}
+    lock = threading.Lock()
+
+    def wait_one(req):
+        resp = broker.wait_response(req.id, timeout=timeout_s)
+        with lock:
+            results[req.id] = resp
+        if resp is not None:
+            dup = broker.wait_response(req.id, timeout=0.2)
+            if dup is not None:
+                with lock:
+                    results[req.id] = "DUPLICATE"
+
+    threads = [
+        threading.Thread(target=wait_one, args=(r,), daemon=True)
+        for r in reqs
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout_s + 5)
+    return results
+
+
+def _audit(reqs, results):
+    """Assert the terminal-response contract over a chaos run."""
+    successes = 0
+    for r in reqs:
+        got = results.get(r.id)
+        assert got is not None, f"request {r.id} never answered (lost)"
+        assert got != "DUPLICATE", f"request {r.id} answered twice"
+        if not got.error:
+            expect = ScriptedEngine.expected_tokens(
+                list(r.token_ids), r.max_new_tokens
+            )
+            assert got.token_ids == expect, f"corrupt payload for {r.id}"
+            successes += 1
+    return successes
+
+
+def _run_fleet(make_worker_broker, producer_broker, n_requests=24,
+               n_workers=2, seed=0):
+    """Kill-heavy chaos run: every request must still be answered once."""
+    reqs = [
+        GenerateRequest(
+            token_ids=[i + 1], max_new_tokens=4,
+            deadline_ts=time.time() + 60.0,
+        )
+        for i in range(n_requests)
+    ]
+    hosts = []
+    for i in range(n_workers):
+        chaos = ChaosBroker(
+            make_worker_broker(i), seed=seed + i,
+            kill_after_pop_prob=0.15, drop_response_prob=0.1,
+        )
+
+        def factory(chaos=chaos):
+            return Worker(
+                ScriptedEngine(), chaos, batch_size=2,
+                poll_timeout_s=0.02, pad_batch=False,
+            )
+
+        hosts.append(ChaosWorkerHost(factory, respawn_delay_s=0.01))
+
+    for r in reqs:
+        producer_broker.push_request(r)
+    for h in hosts:
+        h.start()
+    try:
+        results = _collect(producer_broker, reqs, timeout_s=60.0)
+    finally:
+        for h in hosts:
+            h.stop()
+
+    assert not [h.error for h in hosts if h.error]
+    successes = _audit(reqs, results)
+    # The error-path responses are dead-letters from repeated kills —
+    # legitimate terminal answers — but chaos at these rates must not
+    # wipe out the run.
+    assert successes >= n_requests // 2
+    assert sum(h.kills for h in hosts) > 0, "chaos schedule never fired"
+    return hosts
+
+
+def test_chaos_inproc_every_request_answered_once():
+    b = InProcBroker(lease_s=0.15, max_delivery_attempts=6)
+    _run_fleet(lambda i: b, b)
+
+
+def test_chaos_fakeredis_every_request_answered_once():
+    """Same contract through the real RedisBroker code paths (per-worker
+    lease keys, SCAN-based reaper, DLQ list) on FakeRedis."""
+    server = FakeRedis()
+
+    def mk(i):
+        return RedisBroker(
+            client=server, worker_id=f"w{i}", lease_s=0.15,
+            max_delivery_attempts=6,
+        )
+
+    producer = RedisBroker(
+        client=server, worker_id="producer", lease_s=0.15,
+        max_delivery_attempts=6,
+    )
+    _run_fleet(mk, producer)
+
+
+def test_poison_request_lands_in_dlq_fleet_stays_healthy():
+    """A request that deterministically crashes whichever worker takes it
+    must end up quarantined after max_delivery_attempts kills — with the
+    fleet alive, the other requests served, and the poison visible on the
+    admin surfaces (/dlq, /metrics) with /health still 200."""
+    b = InProcBroker(lease_s=0.1, max_delivery_attempts=3)
+
+    def factory():
+        # batch_size=1 so the poison takes down only its own lease, and
+        # kill_on_poison simulates the chip reset.
+        return Worker(
+            ScriptedEngine(kill_on_poison=True), b, batch_size=1,
+            poll_timeout_s=0.02, pad_batch=False,
+        )
+
+    host = ChaosWorkerHost(factory, respawn_delay_s=0.01)
+    poison = GenerateRequest(
+        id="poison", token_ids=[POISON_TOKEN], max_new_tokens=4,
+        deadline_ts=time.time() + 60.0,
+    )
+    normals = [
+        GenerateRequest(
+            id=f"n{i}", token_ids=[i + 1], max_new_tokens=4,
+            deadline_ts=time.time() + 60.0,
+        )
+        for i in range(4)
+    ]
+    b.push_request(poison)
+    for r in normals:
+        b.push_request(r)
+
+    srv = ProducerServer(b, host="127.0.0.1", port=0)
+    srv.start()
+    host.start()
+    try:
+        results = _collect(b, [poison] + normals, timeout_s=30.0)
+    finally:
+        host.stop()
+
+    try:
+        assert host.error is None
+        # Each delivery attempt killed a worker; then quarantine.
+        assert host.kills == 3
+        assert host.spawns >= host.kills + 1  # fleet kept respawning
+        presp = results["poison"]
+        assert presp not in (None, "DUPLICATE")
+        assert "dead-lettered after 3" in presp.error
+        assert b.dlq_depth() == 1
+        assert b.read_dlq()[0]["id"] == "poison"
+        # Normal traffic survived the poison.
+        for r in normals:
+            got = results[r.id]
+            assert got not in (None, "DUPLICATE") and not got.error
+            assert got.token_ids == ScriptedEngine.expected_tokens(
+                list(r.token_ids), r.max_new_tokens
+            )
+        # Admin surfaces agree and the producer still reports healthy.
+        import http.client
+        import json
+
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        conn.request("GET", "/health")
+        assert conn.getresponse().status == 200
+        conn.request("GET", "/dlq")
+        r = conn.getresponse()
+        body = json.loads(r.read())
+        assert r.status == 200 and body["depth"] == 1
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_hardkill_escapes_worker_containment():
+    """The per-batch ``except Exception`` containment must NOT catch a
+    HardKill: a real SIGKILL would never produce error responses."""
+    b = InProcBroker(lease_s=5.0)
+    w = Worker(
+        ScriptedEngine(kill_on_poison=True), b, batch_size=1,
+        poll_timeout_s=0.02, pad_batch=False,
+    )
+    b.push_request(GenerateRequest(
+        id="poison", token_ids=[POISON_TOKEN], max_new_tokens=2,
+    ))
+    from llmss_tpu.serve.chaos import HardKill
+
+    with pytest.raises(HardKill):
+        w.run_once()
+    # No terminal response was emitted; the lease is still outstanding.
+    assert b.wait_response("poison", timeout=0.05) is None
+    assert b.delivery_stats()["inflight"] == 1

@@ -146,6 +146,47 @@ def test_factory_failure_is_contained():
     assert broker.read_metrics()["supervisor"]["alive"] is False
 
 
+def _paid_backoffs(stable_after_s):
+    """Run a {crash@2, crash@6} schedule and return the restart delay the
+    supervisor was about to pay at each crash (``backoff_current`` at crash
+    time is exactly the upcoming wait)."""
+    broker = InProcBroker()
+    record = []
+    paid = []
+    sup = None
+
+    class Recording(FlakyWorker):
+        def run_once(self):
+            if FlakyWorker.calls + 1 in self.crash_at:
+                paid.append(sup.backoff_current)
+            super().run_once()
+
+    sup = Supervisor(
+        lambda: Recording({2, 6}, record), broker,
+        backoff_s=0.01, stable_after_s=stable_after_s, heartbeat_s=0.0,
+    )
+    _run_until(sup, 6, record)
+    assert sup.restarts == 2
+    return paid, broker
+
+
+def test_backoff_grows_without_stability_reset():
+    """Crashes spaced closer than ``stable_after_s`` keep doubling the
+    restart delay: the second crash pays 2x the first."""
+    paid, broker = _paid_backoffs(stable_after_s=3600.0)
+    assert paid == [pytest.approx(0.01), pytest.approx(0.02)]
+    # Observable to operators through the health/metrics channel.
+    assert "backoff_current_s" in broker.read_metrics()["supervisor"]
+
+
+def test_backoff_resets_after_stable_run():
+    """A worker that stays up past ``stable_after_s`` earns its backoff
+    back: the second crash pays ``backoff_s`` again, not the doubled
+    carry-over from the first."""
+    paid, _ = _paid_backoffs(stable_after_s=0.0)
+    assert paid == [pytest.approx(0.01), pytest.approx(0.01)]
+
+
 def test_clean_stop():
     broker = InProcBroker()
     record = []

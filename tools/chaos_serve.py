@@ -1,0 +1,183 @@
+"""Chaos runner: hammer the delivery substrate with worker kills and lost
+responses, then audit that every accepted request got exactly one terminal
+response.
+
+This is the executable form of the at-least-once contract in
+``serve/broker.py``: producers push N requests with deadlines, a fleet of
+``ChaosWorkerHost``-hosted workers serves them through ``ChaosBroker``
+proxies that hard-kill workers mid-lease and drop terminal responses, and
+the audit at the end fails the process (exit 1) if any accepted request was
+lost, answered twice, or answered with the wrong payload.
+
+No server, no device: the engine is ``ScriptedEngine`` (deterministic
+tokens, so payloads are checkable) and ``--broker fakeredis`` runs the real
+``RedisBroker`` code against the in-memory ``FakeRedis``.
+
+Examples::
+
+    python tools/chaos_serve.py --requests 50 --workers 3 \
+        --kill-prob 0.2 --drop-response-prob 0.1
+    python tools/chaos_serve.py --broker fakeredis --poison 2 \
+        --max-attempts 3
+
+Prints a one-line JSON delivery report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from llmss_tpu.serve.broker import InProcBroker, RedisBroker  # noqa: E402
+from llmss_tpu.serve.chaos import (  # noqa: E402
+    POISON_TOKEN, ChaosBroker, ChaosWorkerHost, FakeRedis, ScriptedEngine,
+)
+from llmss_tpu.serve.consumer import Worker  # noqa: E402
+from llmss_tpu.serve.protocol import GenerateRequest  # noqa: E402
+
+
+def build_brokers(args):
+    """(producer_broker, [worker_broker...]) sharing one substrate."""
+    if args.broker == "inproc":
+        b = InProcBroker(
+            lease_s=args.lease_s, max_delivery_attempts=args.max_attempts
+        )
+        return b, [b] * args.workers
+    server = FakeRedis()
+
+    def mk(worker_id):
+        return RedisBroker(
+            client=server, worker_id=worker_id, lease_s=args.lease_s,
+            max_delivery_attempts=args.max_attempts,
+        )
+
+    return mk("producer"), [mk(f"worker{i}") for i in range(args.workers)]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        "chaos_serve", description=__doc__.split("\n")[0]
+    )
+    p.add_argument("--requests", type=int, default=40)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--broker", choices=("inproc", "fakeredis"),
+                   default="inproc")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--kill-prob", type=float, default=0.15,
+                   help="P(hard-kill worker right after it leases a request)")
+    p.add_argument("--drop-response-prob", type=float, default=0.1,
+                   help="P(a terminal response is silently lost)")
+    p.add_argument("--lease-s", type=float, default=0.5)
+    p.add_argument("--max-attempts", type=int, default=6)
+    p.add_argument("--poison", type=int, default=0,
+                   help="requests whose prompt reliably crashes a worker "
+                        "(expected to land in the DLQ)")
+    p.add_argument("--deadline-s", type=float, default=60.0,
+                   help="end-to-end deadline stamped on every request")
+    p.add_argument("--batch-size", type=int, default=1)
+    args = p.parse_args(argv)
+
+    prod_broker, worker_brokers = build_brokers(args)
+
+    hosts = []
+    for i, wb in enumerate(worker_brokers):
+        chaos = ChaosBroker(
+            wb, seed=args.seed + i,
+            kill_after_pop_prob=args.kill_prob,
+            drop_response_prob=args.drop_response_prob,
+        )
+
+        def factory(chaos=chaos):
+            return Worker(
+                ScriptedEngine(kill_on_poison=True), chaos,
+                batch_size=args.batch_size, poll_timeout_s=0.05,
+                pad_batch=False,
+            )
+
+        hosts.append(ChaosWorkerHost(factory, respawn_delay_s=0.02))
+
+    # -- submit --------------------------------------------------------------
+    reqs = []
+    for i in range(args.requests):
+        prompt = [POISON_TOKEN] if i < args.poison else [i % 1000 + 1]
+        reqs.append(GenerateRequest(
+            token_ids=prompt, max_new_tokens=4,
+            deadline_ts=time.time() + args.deadline_s,
+        ))
+    for r in reqs:
+        prod_broker.push_request(r)
+
+    for h in hosts:
+        h.start()
+
+    # -- collect: one waiter thread per request ------------------------------
+    results: dict[str, object] = {}
+    lock = threading.Lock()
+
+    def wait_one(req):
+        resp = prod_broker.wait_response(req.id, timeout=args.deadline_s)
+        with lock:
+            results[req.id] = resp
+        # A second terminal response for the same id is a contract
+        # violation; probe briefly.
+        dup = prod_broker.wait_response(req.id, timeout=0.2)
+        if dup is not None:
+            with lock:
+                results[req.id] = "DUPLICATE"
+
+    waiters = [
+        threading.Thread(target=wait_one, args=(r,), daemon=True)
+        for r in reqs
+    ]
+    for t in waiters:
+        t.start()
+    for t in waiters:
+        t.join(timeout=args.deadline_s + 5)
+    for h in hosts:
+        h.stop()
+
+    # -- audit ---------------------------------------------------------------
+    lost, dup, wrong, ok, errored = [], [], [], 0, 0
+    for r in reqs:
+        got = results.get(r.id)
+        if got is None:
+            lost.append(r.id)
+        elif got == "DUPLICATE":
+            dup.append(r.id)
+        elif got.error:
+            errored += 1
+        elif got.token_ids != ScriptedEngine.expected_tokens(
+            list(r.token_ids), r.max_new_tokens
+        ):
+            wrong.append(r.id)
+        else:
+            ok += 1
+
+    report = {
+        "requests": args.requests,
+        "ok": ok,
+        "errored": errored,
+        "lost": len(lost),
+        "duplicates": len(dup),
+        "wrong_payload": len(wrong),
+        "kills": sum(h.kills for h in hosts),
+        "spawns": sum(h.spawns for h in hosts),
+        "dlq_depth": prod_broker.dlq_depth(),
+        "delivery": prod_broker.delivery_stats(),
+        "host_errors": [h.error for h in hosts if h.error],
+    }
+    print(json.dumps(report))
+    violations = lost or dup or wrong or report["host_errors"]
+    if args.poison and prod_broker.dlq_depth() < args.poison:
+        violations = True
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
