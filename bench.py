@@ -190,7 +190,7 @@ def _decode_slope_ms(engine, ids, lens, sa, eos, batch, n_slope):
             tok, cur = tok0, cur0
             total = jnp.zeros((), jnp.int32)
             for k, tb in sched:
-                toks, cache, cur, _ = engine._decode_many(
+                toks, cache, cur, _, _ = engine._decode_many(
                     engine.params, tok, cache, cur, sa, done, eos,
                     n_steps=k, t_bucket=tb,
                 )

@@ -318,28 +318,40 @@ class DecodeEngine:
         cfg, mesh, params, tokens, cache, cur_pos, sample_args, done,
         eos, *, n_steps: int, t_bucket: int | None = None,
     ):
-        """Fused multi-token decode: lax.scan over the single-token step."""
+        """Fused multi-token decode: lax.scan over the single-token step.
+
+        Returns a 5th array, ``poisoned`` [B] bool: rows whose logits went
+        non-finite at any step of this chunk (ops/sampling.nonfinite_rows).
+        A poisoned row is forced done on device — its later "tokens" are
+        EOS fills — and the host errors out exactly that row; co-batched
+        rows never see it (row isolation is positional)."""
         from llmss_tpu.models.decoder import forward
 
         def body(carry, _):
-            tokens, cache, cur_pos, done = carry
+            tokens, cache, cur_pos, done, poisoned = carry
             positions = cur_pos[:, None]
             slots = positions % cache.max_len
             logits, cache = forward(
                 cfg, params, tokens[:, None], positions, cache, slots,
                 last_only=True, mesh=mesh, t_bucket=t_bucket,
             )
-            tok = sample(logits[:, 0], counters=cur_pos + 1, **sample_args)
-            tok = jnp.where(done, eos, tok)
-            done = done | (tok == eos)
-            cur_pos = cur_pos + 1
-            return (tok, cache, cur_pos, done), tok
+            from llmss_tpu.ops.sampling import nonfinite_rows
 
+            bad = nonfinite_rows(logits[:, 0]) & ~done
+            poisoned = poisoned | bad
+            tok = sample(logits[:, 0], counters=cur_pos + 1, **sample_args)
+            tok = jnp.where(done | bad, eos, tok)
+            done = done | bad | (tok == eos)
+            cur_pos = cur_pos + 1
+            return (tok, cache, cur_pos, done, poisoned), tok
+
+        poisoned0 = jnp.zeros_like(done)
         carry, toks = jax.lax.scan(
-            body, (tokens, cache, cur_pos, done), None, length=n_steps
+            body, (tokens, cache, cur_pos, done, poisoned0), None,
+            length=n_steps,
         )
-        tokens, cache, cur_pos, done = carry
-        return toks.T, cache, cur_pos, done  # toks [B, n_steps]
+        tokens, cache, cur_pos, done, poisoned = carry
+        return toks.T, cache, cur_pos, done, poisoned  # toks [B, n_steps]
 
     # -- host API -----------------------------------------------------------
 
@@ -490,7 +502,7 @@ class DecodeEngine:
             done = self.canon_vec(jnp.zeros(batch, bool))
             eos = self.canon_vec(jnp.full(batch, -1, jnp.int32))
             for tb in bucket_set:
-                _, c2, _, _ = self._decode_many(
+                _, c2, _, _, _ = self._decode_many(
                     self.params, tok, cache, cur, sa, done, eos,
                     n_steps=k, t_bucket=tb,
                 )
@@ -604,6 +616,7 @@ class DecodeEngine:
         *,
         on_token=None,
         on_increment=None,
+        on_poisoned=None,
         cancel_poll=None,
         chunk_steps: int = 1,
         live_rows: int | None = None,
@@ -628,6 +641,11 @@ class DecodeEngine:
         called only for tokens actually ACCEPTED into a row's output (EOS
         and post-completion fills excluded) — the serving layer streams
         from here with engine-owned completion semantics. Stops early when every row is done.
+        ``on_poisoned(row)`` (optional) fires when a row's logits go
+        non-finite mid-decode (``chunk_steps > 1`` path — the serving
+        path): that row stops decoding with the tokens produced before the
+        poison, co-batched rows are unaffected, and the caller should
+        answer the row with an error rather than a truncated success.
         ``cancel_poll() -> iterable[int]`` (optional) is polled for row
         indices whose clients went away: those rows stop accumulating
         tokens and count as done.
@@ -765,7 +783,7 @@ class DecodeEngine:
                 flush_increments()
             else:
                 t0 = time.perf_counter()
-                toks, cache, cur_pos, _ = self._decode_many(
+                toks, cache, cur_pos, _, poisoned = self._decode_many(
                     self.params, tok, cache, cur_pos, sample_args,
                     self.canon_vec(jnp.asarray(done)), eos_dev, n_steps=k,
                     t_bucket=self.decode_bucket(pos_hi + k),
@@ -774,6 +792,7 @@ class DecodeEngine:
                 cur_pos = self.canon_vec(cur_pos)
                 pos_hi += k
                 chunk_np = np.asarray(toks)  # [B, k] — the real host sync
+                poisoned_np = np.asarray(poisoned)
                 self.metrics.decode_step.record(
                     (time.perf_counter() - t0) / k
                 )
@@ -781,6 +800,16 @@ class DecodeEngine:
                 for col in range(k):
                     if process(chunk_np[:, col]):
                         break
+                # Poisoned rows were forced done on device (EOS-filled from
+                # the bad step on), so process() already stopped accepting
+                # their tokens; surface the flag so the caller errors the
+                # row instead of returning a silently truncated success.
+                for i in range(B):
+                    if poisoned_np[i] and not done[i]:
+                        done[i] = True
+                if on_poisoned is not None:
+                    for i in np.flatnonzero(poisoned_np):
+                        on_poisoned(int(i))
                 flush_increments()
         self.metrics.add_tokens(
             sum(len(o) for o in out[: live_rows or B])
@@ -825,7 +854,7 @@ class DecodeEngine:
         )
         eos_dev = self.canon_vec(jnp.full(B, int(eos), jnp.int32))
         done = self.canon_vec(tok == eos_dev)
-        toks, cache, _, done = self._decode_many(
+        toks, cache, _, done, _ = self._decode_many(
             self.params, tok, cache, self.canon_vec(jnp.asarray(lens)),
             sample_args, done, eos_dev, n_steps=gen.max_new_tokens - 1,
             t_bucket=self.decode_bucket(

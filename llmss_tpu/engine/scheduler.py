@@ -92,6 +92,11 @@ class _InFlightChunk:
     # previous chunk and this one, so this chunk's fetch-to-fetch interval
     # is not a clean decode-only sample.
     has_admission: bool = False
+    # [rows] bool (device): rows whose logits went non-finite during this
+    # chunk (ops.sampling.nonfinite_rows inside the fused scan). The device
+    # already forced these rows done (EOS fills from the bad step on);
+    # _process_chunk errors them out instead of reporting a success.
+    poisoned: jax.Array | None = None
 
 
 class ContinuousBatcher:
@@ -259,7 +264,7 @@ class ContinuousBatcher:
         sa = eng._sample_args(GenerationParams(), self.rows)
         for k in sorted({self.chunk_steps, self.chunk_steps_low}):
             for tb in eng.prewarm_bucket_set():
-                toks, cache, cur_pos, _ = eng._decode_many(
+                toks, cache, cur_pos, _, _ = eng._decode_many(
                     eng.params, self._tokens_dev, self.cache,
                     self._cur_pos_dev, sa,
                     jnp.ones(self.rows, bool),
@@ -474,13 +479,22 @@ class ContinuousBatcher:
                 self._flush_stream(r)
         return n
 
-    def _finish(self, row: int, r: _Row, cancelled: bool = False) -> None:
+    def _finish(
+        self, row: int, r: _Row, cancelled: bool = False,
+        error: str | None = None,
+    ) -> None:
         self.active.pop(row, None)
         self._row_pos.pop(row, None)
         with self._lock:
             self._free.append(row)
         self._flush_stream(r)
-        if cancelled:
+        if error is not None:
+            # Keyword-only on the error path: existing 2-positional-arg
+            # callbacks (tests, batch worker) never see it, and a callback
+            # that doesn't accept it raising TypeError is the right
+            # loud failure for a serving layer that can't report errors.
+            r.done_cb(r.out, error=error)
+        elif cancelled:
             r.done_cb(r.out, True)
         else:
             r.done_cb(r.out)
@@ -557,6 +571,17 @@ class ContinuousBatcher:
                 self._free.append(row)
         return ids
 
+    def drop_pending(self) -> list[str]:
+        """Remove every PENDING (never-admitted) request and return its id
+        WITHOUT firing callbacks — drain-deadline path: work the device
+        never touched goes back to the broker queue for another worker
+        (``release_requests``) instead of being answered with an error.
+        Active rows are not touched; the caller aborts those separately."""
+        with self._lock:
+            ids = [req_id for (req_id, *_rest) in self.pending]
+            self.pending.clear()
+        return ids
+
     def _chunk_args(self):
         """Per-chunk host-side control arrays. ``done``/``eos``/sampling
         params come from the host's (one-chunk-lagged) view — a row that
@@ -581,6 +606,10 @@ class ContinuousBatcher:
         running on device) and apply host bookkeeping: per-row token
         accounting, stream flushes, EOS / max-token finishes."""
         toks_np = np.asarray(chunk.toks)  # [rows, k] — the blocking fetch
+        poisoned_np = (
+            np.asarray(chunk.poisoned) if chunk.poisoned is not None
+            else np.zeros(self.rows, bool)
+        )
         now = time.perf_counter()
         if self._last_fetch_t is not None and not chunk.has_admission:
             # Fetch-to-fetch interval — but only for chunks with no
@@ -597,6 +626,20 @@ class ContinuousBatcher:
             r = self.active[i]
             if r.awaiting_first:
                 continue  # admitted after this chunk was dispatched
+            if poisoned_np[i]:
+                # Checked BEFORE token processing: the device EOS-filled the
+                # poisoned row from the bad step on (with -1 when the row
+                # has no eos), so its chunk tokens would otherwise read as a
+                # clean early finish. Error the row with the tokens produced
+                # before the poison; co-batched rows are untouched (row
+                # isolation is positional — a NaN never crosses rows).
+                self.engine.metrics.add_poisoned(1)
+                self._finish(
+                    i, r,
+                    error="non-finite logits: row poisoned "
+                          "(NaN/inf in model output)",
+                )
+                continue
             eos = r.gen.eos_token_id if r.gen.eos_token_id is not None else -1
             finished = False
             for col in range(chunk.k):
@@ -661,7 +704,7 @@ class ContinuousBatcher:
         t_bucket = self.engine.decode_bucket(
             max(self._row_pos.values(), default=0) + k
         )
-        toks, cache, cur_pos, _ = self.engine._decode_many(
+        toks, cache, cur_pos, _, poisoned = self.engine._decode_many(
             self.engine.params, self._tokens_dev, self.cache,
             self._cur_pos_dev, sa, jnp.asarray(done), jnp.asarray(eos_arr),
             n_steps=k, t_bucket=t_bucket,
@@ -673,13 +716,15 @@ class ContinuousBatcher:
         self._tokens_dev = self.engine.canon_vec(toks[:, -1])
         try:
             toks.copy_to_host_async()
+            poisoned.copy_to_host_async()
         except AttributeError:
             pass
         # The admission dispatched LAST step sits between the previous
         # chunk and this one on the device queue, so this chunk's
         # fetch-to-fetch interval includes its prefill+insert+merge time.
         chunk = _InFlightChunk(
-            toks=toks, k=k, has_admission=self._pending_adm is not None
+            toks=toks, k=k, has_admission=self._pending_adm is not None,
+            poisoned=poisoned,
         )
 
         prev, self._inflight = self._inflight, chunk

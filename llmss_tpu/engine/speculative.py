@@ -368,7 +368,7 @@ def generate_speculative(
         eos_dev = engine.canon_vec(jnp.full(B, eos_val, jnp.int32))
         k = 16
         while not done_np.all():
-            toks, cache, cur, _ = engine._decode_many(
+            toks, cache, cur, _, _ = engine._decode_many(
                 engine.params, tok_cur, cache, cur, sa,
                 engine.canon_vec(jnp.asarray(done_np)), eos_dev,
                 n_steps=k, t_bucket=engine.decode_bucket(pos_hi + k),

@@ -40,6 +40,20 @@ jax.config.update("jax_threefry_partitionable", True)
 TOPK_BUCKET = 64
 
 
+def nonfinite_rows(logits: jax.Array) -> jax.Array:
+    """[B] bool poison flags: True where a row's logits contain NaN/inf.
+
+    A single overflowed matmul (bad weights, a corrupted KV row, an fp8
+    overflow upstream) turns that row's distribution into garbage — argmax
+    over NaN is backend-defined and categorical draws from nothing — but
+    only *that* row: batch rows never mix. This check runs inside the
+    jitted decode chunk so the serving layer can error out exactly the
+    poisoned row while co-batched rows keep their exact solo tokens,
+    instead of crashing (and re-crashing, on redelivery) the whole batch.
+    """
+    return ~jnp.all(jnp.isfinite(logits), axis=-1)
+
+
 def row_keys(seeds: jax.Array, counters: jax.Array) -> jax.Array:
     """[B] PRNG keys, one per batch row: fold the token counter into the
     request seed's key stream."""

@@ -83,6 +83,15 @@ class Broker(abc.ABC):
         Returns the number of leases reaped."""
         return 0
 
+    def release_requests(self, request_ids) -> int:
+        """Voluntarily return leased-but-never-started requests to the
+        queue (drain deadline: the worker is exiting and another worker
+        should take them). Unlike a lease expiry this is NOT a failure —
+        the delivery attempt is refunded, so a request bounced by draining
+        workers never inches toward the DLQ. Unknown ids are ignored.
+        Returns the number of requests requeued."""
+        return 0
+
     def queue_depth(self) -> int:
         """Requests waiting in the queue (not counting leased in-flight
         ones) — the producer's admission-control signal."""
@@ -320,6 +329,19 @@ class InProcBroker(Broker):
                 self._requests.put(req)
         return len(dead)
 
+    def release_requests(self, request_ids) -> int:
+        n = 0
+        for rid in request_ids:
+            with self._lease_lock:
+                held = self._leases.pop(rid, None)
+            if held is None:
+                continue
+            req = held[1]
+            req.delivery_attempts = max(0, req.delivery_attempts - 1)
+            self._requests.put(req)
+            n += 1
+        return n
+
     def queue_depth(self) -> int:
         return self._requests.qsize()
 
@@ -493,6 +515,25 @@ class RedisBroker(Broker):
                 # RPUSH: the pop side RPOPs, so a redelivered (oldest)
                 # request goes to the head of the service order.
                 self._r.rpush(self._rq, req.to_json())
+            n += 1
+        return n
+
+    def release_requests(self, request_ids) -> int:
+        import json
+
+        n = 0
+        for rid in request_ids:
+            key = self._lease_key(rid)
+            raw = self._r.get(key)
+            if raw is None:
+                continue
+            if not self._r.delete(key):
+                continue  # a reaper claimed it concurrently — it requeues
+            req = GenerateRequest.from_json(json.loads(raw)["req"])
+            req.delivery_attempts = max(0, req.delivery_attempts - 1)
+            # RPUSH like the reaper: released (oldest) work goes back to
+            # the head of the service order.
+            self._r.rpush(self._rq, req.to_json())
             n += 1
         return n
 

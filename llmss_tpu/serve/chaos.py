@@ -40,6 +40,11 @@ logger = logging.getLogger("llmss_tpu.serve")
 # A prompt containing this token id "crashes the chip" when the scripted
 # engine runs with kill_on_poison=True.
 POISON_TOKEN = 666_000
+# A prompt containing this token id gets its row's logits "poisoned"
+# (NaN/inf) when the scripted engine runs with nan_at set — the fault the
+# engine's per-row containment (ops.sampling.nonfinite_rows) must catch
+# without touching batch-mates.
+NAN_TOKEN = 666_001
 
 
 class HardKill(BaseException):
@@ -173,12 +178,30 @@ class ScriptedEngine:
     With ``kill_on_poison=True``, a batch containing ``POISON_TOKEN``
     raises ``HardKill`` mid-generate — a request that deterministically
     takes down whichever worker leases it.
+
+    Lifecycle fault points (ISSUE 2):
+
+    - ``hang_at=N``: the N-th ``generate`` call (1-based, counted on this
+      instance — share one instance across supervised restarts so the hang
+      fires once) stalls for ``hang_s`` before doing any work, sleeping in
+      small increments so a watchdog's async ``WatchdogTimeout`` lands
+      promptly. Models a wedged device step: no progress, no publishes,
+      no lease touches.
+    - ``nan_at=N``: from the N-th call on, any row whose prompt contains
+      ``NAN_TOKEN`` is *poisoned* — ``on_poisoned(row)`` fires and the row
+      produces no tokens, while batch-mates get their exact solo tokens.
+      Mirrors the real engine's jitted NaN/inf containment surface.
     """
 
     def __init__(self, *, kill_on_poison: bool = False,
-                 chunk_delay_s: float = 0.0):
+                 chunk_delay_s: float = 0.0,
+                 hang_at: int | None = None, hang_s: float = 30.0,
+                 nan_at: int | None = None):
         self.kill_on_poison = kill_on_poison
         self.chunk_delay_s = chunk_delay_s
+        self.hang_at = hang_at
+        self.hang_s = hang_s
+        self.nan_at = nan_at
         self.metrics = EngineMetrics()
         self.generate_calls = 0
         self.max_seq_len = 4096
@@ -195,17 +218,35 @@ class ScriptedEngine:
         return [(prompt[-1] + k + 1) % 50257 for k in range(max_new_tokens)]
 
     def generate(self, prompts, gens, cancel_poll=None, on_increment=None,
-                 chunk_steps: int = 8, live_rows: int | None = None):
+                 on_poisoned=None, chunk_steps: int = 8,
+                 live_rows: int | None = None):
         self.generate_calls += 1
         n_live = len(prompts) if live_rows is None else live_rows
         if self.kill_on_poison and any(
             POISON_TOKEN in p for p in prompts[:n_live]
         ):
             raise HardKill("poison request: simulated chip reset")
+        if self.hang_at is not None and self.generate_calls == self.hang_at:
+            # Wedged device step: sleep in small quanta so an async
+            # WatchdogTimeout (injected at a bytecode boundary) lands
+            # within ~one quantum instead of after the whole hang.
+            deadline = time.monotonic() + self.hang_s
+            while time.monotonic() < deadline:
+                time.sleep(0.005)
+        poisoned_rows = set()
+        if self.nan_at is not None and self.generate_calls >= self.nan_at:
+            poisoned_rows = {
+                row for row in range(n_live)
+                if NAN_TOKEN in prompts[row]
+            }
         outs = [
-            self.expected_tokens(p, g.max_new_tokens)
-            for p, g in zip(prompts, gens)
+            [] if row in poisoned_rows
+            else self.expected_tokens(p, g.max_new_tokens)
+            for row, (p, g) in enumerate(zip(prompts, gens))
         ]
+        if on_poisoned is not None:
+            for row in sorted(poisoned_rows):
+                on_poisoned(row)
         steps = max(g.max_new_tokens for g in gens) if gens else 0
         for start in range(0, steps, max(chunk_steps, 1)):
             if self.chunk_delay_s:

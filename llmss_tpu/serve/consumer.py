@@ -75,6 +75,22 @@ class Worker:
         # multi-second stalls under bursty load. Batch rows run in parallel
         # on the chip, so the dummy rows are ~free.
         self.pad_batch = pad_batch
+        # Lifecycle (supervisor drain contract): once draining, run_once
+        # stops leasing — and since a batch worker holds requests only
+        # INSIDE run_once, it is fully drained the moment the current batch
+        # finishes.
+        self.draining = False
+        # Wall-clock stamp of the last demonstrable worker progress (batch
+        # boundaries + every decode chunk via cancel_poll). The supervisor
+        # watchdog reads it from another thread; the heartbeat publishes it.
+        self.last_progress_ts = 0.0
+
+    def begin_drain(self) -> None:
+        self.draining = True
+
+    @property
+    def drained(self) -> bool:
+        return self.draining
 
     def prewarm(self) -> int:
         """Compile the worker's full executable envelope up front (every
@@ -110,6 +126,9 @@ class Worker:
     # -- serving loop -------------------------------------------------------
 
     def run_once(self) -> int:
+        self.last_progress_ts = time.time()
+        if self.draining:
+            return 0  # stop leasing; nothing held between batches
         batch = self._gather()
         if not batch:
             return 0
@@ -163,12 +182,13 @@ class Worker:
 
         def cancel_poll():
             # Mid-batch cancellation: stop spending decode steps on rows
-            # whose clients are gone. Publishing here also keeps the
-            # supervisor heartbeat fresh through a long batch (the merge
-            # hook stamps heartbeat_ts at publish time) — without it a
+            # whose clients are gone. Stamping progress here (once per
+            # decode chunk) is what keeps the watchdog and the supervisor
+            # heartbeat truthful through a long batch — without it a
             # multi-thousand-token batch reads as a hung worker. Touching
             # the leases here keeps a long decode from being mistaken for
             # a dead worker (same cadence, one decode chunk).
+            self.last_progress_ts = time.time()
             self.broker.publish_metrics(self.engine.metrics.to_dict())
             self.broker.touch_requests([r.id for r in ok])
             hits = self.broker.check_cancelled(
@@ -186,10 +206,12 @@ class Worker:
             if row < n_live and ok[row].stream:
                 self.broker.push_stream(ok[row].id, new_toks)
 
+        poisoned_rows: set[int] = set()
         try:
             outs = self.engine.generate(
                 prompts, gens, cancel_poll=cancel_poll,
                 on_increment=on_increment,
+                on_poisoned=poisoned_rows.add,
                 chunk_steps=self.chunk_steps, live_rows=n_live,
             )[:n_live]
         except Exception as e:  # noqa: BLE001 — batch failure containment
@@ -204,7 +226,21 @@ class Worker:
             self.broker.publish_metrics(self.engine.metrics.to_dict())
             return len(batch)
 
-        for req, toks in zip(ok, outs):
+        for row, (req, toks) in enumerate(zip(ok, outs)):
+            if row in poisoned_rows:
+                # Per-row poison containment: this row's logits went
+                # NaN/inf mid-decode. Only this row errors — batch-mates
+                # keep their exact solo tokens (row isolation).
+                self.engine.metrics.add_poisoned()
+                self.broker.push_response(
+                    GenerateResponse(
+                        id=req.id,
+                        error="non-finite logits: row poisoned "
+                              "(NaN/inf in model output)",
+                        token_ids=toks,
+                    )
+                )
+                continue
             if req.id in mid_cancelled:
                 # The client is by definition gone — an honest "cancelled"
                 # error (with the partial tokens), not a fake success.
@@ -257,6 +293,8 @@ class ContinuousWorker:
         )
         self.poll_timeout_s = poll_timeout_s
         self._publish_counter = 0
+        self.draining = False
+        self.last_progress_ts = 0.0
         # Retained prefix segments keyed by their token tuple (LRU):
         # requests carrying ``prefix_token_ids`` build the segment once
         # (engine.build_prefix) and every later request sharing it seeds
@@ -302,7 +340,18 @@ class ContinuousWorker:
                 )
                 continue
 
-            def cb(toks, cancelled=False, req=req):
+            def cb(toks, cancelled=False, error=None, req=req):
+                if error is not None:
+                    # Row-level failure (e.g. poison containment): the
+                    # batcher finished this row with an error; batch-mates
+                    # are untouched.
+                    self.engine.metrics.add_error()
+                    self.broker.push_response(
+                        GenerateResponse(
+                            id=req.id, error=error, token_ids=toks,
+                        )
+                    )
+                    return
                 if cancelled:
                     # Honest response: the client timed out / went away;
                     # partial tokens ride along, but this is not a success.
@@ -357,7 +406,28 @@ class ContinuousWorker:
             self._prefixes.pop(next(iter(self._prefixes)))
         return pfx
 
+    def begin_drain(self) -> None:
+        """Supervisor drain contract: stop leasing new requests; run_once
+        keeps stepping (cancels, lease renewal, publishes included) until
+        the active rows finish and ack."""
+        self.draining = True
+
+    @property
+    def drained(self) -> bool:
+        return self.draining and self.batcher.idle
+
+    def release_pending(self) -> int:
+        """Drain-deadline fallback, half 1: requests this worker leased
+        but never admitted go back to the broker queue for another worker
+        — no error, no redelivery count against the request. (Half 2, the
+        active rows, gets ``abort_inflight``.)"""
+        ids = self.batcher.drop_pending()
+        if ids:
+            self.broker.release_requests(ids)
+        return len(ids)
+
     def run_once(self) -> int:
+        self.last_progress_ts = time.time()
         # Check the broker's TTL'd cancellation flags for exactly the ids
         # this batcher holds (pending, in-flight admission, active): the
         # flag persists until its request shows up, so cancel-before-submit
@@ -371,7 +441,7 @@ class ContinuousWorker:
             # The batcher frees the row at the top of its next step; the
             # request's done_cb fires with the tokens produced so far.
             self.batcher.cancel(rid)
-        n = self._drain_broker()
+        n = 0 if self.draining else self._drain_broker()
         self.batcher.step()
         self._publish_counter += 1
         # Every 16 iterations even when idle: with chunked steps (~0.3 s
@@ -446,6 +516,17 @@ def main(argv=None):
              "exponential backoff)",
     )
     parser.add_argument("--max_restarts", type=int, default=None)
+    parser.add_argument(
+        "--step_timeout_s", type=float, default=None,
+        help="watchdog: a decode step with no progress for this long is "
+             "escalated as a crash (supervised mode; default: disabled)",
+    )
+    parser.add_argument(
+        "--drain_timeout_s", type=float, default=30.0,
+        help="SIGTERM drain deadline: past it, never-started requests are "
+             "released back to the queue and active rows abort with an "
+             "error instead of pinning the shutdown",
+    )
     args = parser.parse_args(argv)
 
     from transformers import AutoTokenizer
@@ -495,12 +576,34 @@ def main(argv=None):
         + (" (continuous batching)" if args.continuous else "")
         + (" (supervised)" if args.supervise else "")
     )
+    import signal
+
     if args.supervise:
         from llmss_tpu.serve.supervisor import Supervisor
 
-        Supervisor(make_worker, broker, max_restarts=args.max_restarts).run()
+        sup = Supervisor(
+            make_worker, broker, max_restarts=args.max_restarts,
+            step_timeout_s=args.step_timeout_s,
+            drain_timeout_s=args.drain_timeout_s,
+        )
+
+        def _on_sigterm(signum, frame):
+            logger.info("SIGTERM: draining (deadline %.0fs)",
+                        args.drain_timeout_s)
+            sup.drain()
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
+        sup.run()
     else:
-        make_worker().run_forever()
+        w = make_worker()
+
+        def _on_sigterm(signum, frame):
+            logger.info("SIGTERM: draining (unsupervised)")
+            w.begin_drain()
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
+        while not (w.draining and w.drained):
+            w.run_once()
 
 
 if __name__ == "__main__":

@@ -16,12 +16,68 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from llmss_tpu.serve.broker import Broker
-from llmss_tpu.serve.protocol import GenerateRequest
+from llmss_tpu.serve.protocol import (
+    STATE_DEAD,
+    STATE_DRAINING,
+    GenerateRequest,
+)
+
+
+def evaluate_worker_health(
+    sup, saw_supervisor: bool, stale_factor: float = 3.0,
+) -> tuple[int, dict, bool]:
+    """Shared /health policy over the published supervisor block (both
+    producer frontends use it). Returns (status_code, body,
+    saw_supervisor'). 503 statuses, in precedence order:
+
+    - ``no-heartbeat-data``: a supervisor block was seen before but the
+      metrics channel no longer has one (Redis TTL expired — a hung
+      worker must not read as recovered);
+    - ``draining`` / ``dead``: lifecycle says stop sending traffic —
+      draining workers finish their active rows but lease nothing new,
+      dead workers are gone for good;
+    - ``unhealthy``: the supervisor reports the worker not alive
+      (crash-backoff window, watchdog stall);
+    - ``stale-heartbeat``: no demonstrable worker progress for
+      ``stale_factor × heartbeat_s`` — the progress-stamped
+      ``heartbeat_ts`` goes stale even while the supervisor thread is
+      blocked inside a hung ``run_once``."""
+    import time as _time
+
+    if not isinstance(sup, dict) or "heartbeat_ts" not in sup:
+        if saw_supervisor:
+            return 503, {
+                "status": "no-heartbeat-data",
+                "detail": "supervisor block seen before but gone "
+                          "(metrics expired — worker presumed hung)",
+            }, saw_supervisor
+        return 200, {"status": "ok", "worker": "unsupervised"}, saw_supervisor
+    age = _time.time() - float(sup["heartbeat_ts"])
+    stale_after = float(sup.get("heartbeat_s", 5.0)) * stale_factor
+    state = sup.get("state")
+    body = {
+        "heartbeat_age_s": round(age, 3),
+        "stale_after_s": stale_after,
+        "state": state,
+        "restarts": sup.get("restarts"),
+        "watchdog_stalls": sup.get("watchdog_stalls"),
+        "last_error": sup.get("last_error"),
+    }
+    if state in (STATE_DRAINING, STATE_DEAD):
+        return 503, {"status": state, **body}, True
+    if not sup.get("alive", True):
+        return 503, {"status": "unhealthy", **body}, True
+    if age > stale_after:
+        return 503, {"status": "stale-heartbeat", **body}, True
+    return 200, {"status": "ok", **body}, True
 
 
 class ProducerServer:
     # A worker is unhealthy after this many missed heartbeat intervals.
     HEARTBEAT_STALE_FACTOR = 3.0
+    # How long one worker-state read is trusted for admission decisions —
+    # keeps /generate from paying a broker metrics read per request.
+    STATE_MEMO_S = 0.5
 
     def __init__(self, broker: Broker, host: str = "0.0.0.0",
                  port: int = 8000, timeout_s: float = 300.0,
@@ -33,6 +89,8 @@ class ProducerServer:
         # that will blow its deadline anyway (0 disables).
         self.max_queue_depth = max_queue_depth
         self._saw_supervisor = False
+        self._state_memo: str | None = None
+        self._state_memo_until = 0.0
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -68,7 +126,23 @@ class ProducerServer:
 
             def _admit(self, req) -> bool:
                 """Admission control + deadline stamping. Returns False
-                (with the 429 already sent) when the backlog is full."""
+                (with the 429/503 already sent) when the backlog is full
+                or the worker lifecycle says stop sending traffic."""
+                state = outer.worker_unavailable()
+                if state is not None:
+                    # Draining/dead worker: queueing would only strand the
+                    # request past its deadline (draining workers lease
+                    # nothing new). Shed like a load balancer would.
+                    body = json.dumps({
+                        "error": f"worker {state}", "id": req.id,
+                    }).encode()
+                    self.send_response(503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Retry-After", "1")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return False
                 if (
                     outer.max_queue_depth
                     and outer.broker.queue_depth() >= outer.max_queue_depth
@@ -203,42 +277,38 @@ class ProducerServer:
         self._thread: threading.Thread | None = None
 
     def health(self) -> tuple[int, dict]:
-        """Worker-health-aware /health: a supervised worker publishes
-        ``heartbeat_ts`` through the broker metrics channel
-        (serve/supervisor.py); when it goes stale the endpoint flips to
-        503 instead of serving a green light over a hung worker (which
-        would otherwise pile requests into 504s). Without a supervisor
-        block the endpoint stays a liveness-of-the-producer check — but
-        once a supervisor has been seen, its *absence* is itself unhealthy
-        (the Redis metrics key has a TTL: a hung worker's stale block
-        expires after ~120 s, which must not read as recovery)."""
+        """Worker-health-aware /health: a supervised worker publishes its
+        lifecycle state and a progress-stamped ``heartbeat_ts`` through
+        the broker metrics channel (serve/supervisor.py); draining/dead/
+        stalled workers flip this to 503 instead of serving a green light
+        over a worker that won't answer (which would otherwise pile
+        requests into 504s). Policy in ``evaluate_worker_health``."""
+        sup = self.broker.read_metrics().get("supervisor")
+        code, body, self._saw_supervisor = evaluate_worker_health(
+            sup, self._saw_supervisor, self.HEARTBEAT_STALE_FACTOR,
+        )
+        return code, body
+
+    def worker_unavailable(self) -> str | None:
+        """``'draining'`` / ``'dead'`` when the published worker lifecycle
+        says new work must be shed, else None. Memoized for
+        ``STATE_MEMO_S`` so per-request admission doesn't pay a broker
+        metrics read. (One metrics channel — with a multi-worker fleet
+        behind one broker the last publisher wins, so a drain sheds
+        front-door traffic fleet-wide; per-worker health channels are
+        future work.)"""
         import time as _time
 
+        now = _time.monotonic()
+        if now < self._state_memo_until:
+            return self._state_memo
         sup = self.broker.read_metrics().get("supervisor")
-        if not isinstance(sup, dict) or "heartbeat_ts" not in sup:
-            if self._saw_supervisor:
-                return 503, {
-                    "status": "no-heartbeat-data",
-                    "detail": "supervisor block seen before but gone "
-                              "(metrics expired — worker presumed hung)",
-                }
-            return 200, {"status": "ok", "worker": "unsupervised"}
-        self._saw_supervisor = True
-        age = _time.time() - float(sup["heartbeat_ts"])
-        stale_after = (
-            float(sup.get("heartbeat_s", 5.0)) * self.HEARTBEAT_STALE_FACTOR
+        state = sup.get("state") if isinstance(sup, dict) else None
+        self._state_memo = (
+            state if state in (STATE_DRAINING, STATE_DEAD) else None
         )
-        body = {
-            "heartbeat_age_s": round(age, 3),
-            "stale_after_s": stale_after,
-            "restarts": sup.get("restarts"),
-            "last_error": sup.get("last_error"),
-        }
-        if not sup.get("alive", True):
-            return 503, {"status": "unhealthy", **body}
-        if age > stale_after:
-            return 503, {"status": "stale-heartbeat", **body}
-        return 200, {"status": "ok", **body}
+        self._state_memo_until = now + self.STATE_MEMO_S
+        return self._state_memo
 
     @property
     def port(self) -> int:
@@ -264,14 +334,28 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
 
     Full API parity with ``ProducerServer``: POST /generate (JSON or SSE
     streaming via ``stream: true``, same event format, 429 + Retry-After
-    admission control, deadline stamping), POST /cancel, GET /metrics,
-    GET /health, GET /dlq."""
+    admission control, lifecycle-aware 503 shedding, deadline stamping),
+    POST /cancel, GET /metrics, GET /health (worker-health-aware),
+    GET /dlq."""
     import time as _time
 
     from fastapi import FastAPI, HTTPException
     from fastapi.responses import JSONResponse, StreamingResponse
 
     app = FastAPI()
+    hstate = {"saw_supervisor": False, "memo": None, "memo_until": 0.0}
+
+    def _worker_unavailable() -> str | None:
+        now = _time.monotonic()
+        if now < hstate["memo_until"]:
+            return hstate["memo"]
+        sup = broker.read_metrics().get("supervisor")
+        state = sup.get("state") if isinstance(sup, dict) else None
+        hstate["memo"] = (
+            state if state in (STATE_DRAINING, STATE_DEAD) else None
+        )
+        hstate["memo_until"] = now + ProducerServer.STATE_MEMO_S
+        return hstate["memo"]
 
     def _sse(req: GenerateRequest):
         """SSE generator matching ProducerServer._stream_response: one
@@ -314,6 +398,13 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
             req.validate()
         except ValueError as e:
             raise HTTPException(400, str(e)) from e
+        state = _worker_unavailable()
+        if state is not None:
+            return JSONResponse(
+                status_code=503,
+                content={"error": f"worker {state}", "id": req.id},
+                headers={"Retry-After": "1"},
+            )
         if max_queue_depth and broker.queue_depth() >= max_queue_depth:
             return JSONResponse(
                 status_code=429,
@@ -361,7 +452,12 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
 
     @app.get("/health")
     def health():
-        return {"status": "ok"}
+        sup = broker.read_metrics().get("supervisor")
+        code, body, hstate["saw_supervisor"] = evaluate_worker_health(
+            sup, hstate["saw_supervisor"],
+            ProducerServer.HEARTBEAT_STALE_FACTOR,
+        )
+        return JSONResponse(status_code=code, content=body)
 
     return app
 

@@ -13,6 +13,23 @@ import dataclasses
 import json
 import uuid
 
+# Worker lifecycle states, published in the supervisor block of every
+# metrics publish and reflected in producer /health and admission:
+#
+#   starting → ready → draining → dead
+#
+# ``starting``: factory build / prewarm in progress — not serving yet.
+# ``ready``: leasing and serving requests.
+# ``draining``: stopped leasing new work; finishing active rows, then a
+#   clean exit (SIGTERM / Supervisor.drain). Producers shed new requests.
+# ``dead``: the supervisor loop has exited (clean drain, stop, or restart
+#   budget exhausted) and will never serve again.
+STATE_STARTING = "starting"
+STATE_READY = "ready"
+STATE_DRAINING = "draining"
+STATE_DEAD = "dead"
+WORKER_STATES = (STATE_STARTING, STATE_READY, STATE_DRAINING, STATE_DEAD)
+
 
 @dataclasses.dataclass
 class GenerateRequest:

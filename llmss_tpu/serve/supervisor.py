@@ -1,4 +1,4 @@
-"""Serving-loop supervision: crash containment, restart, liveness.
+"""Serving-loop supervision: lifecycle, crash containment, restart, liveness.
 
 The reference has no failure handling at all — a consumer crash kills the
 job and nothing notices (SURVEY.md §5 "Failure detection: absent", the only
@@ -8,21 +8,54 @@ retries). Here the worker loop runs under a supervisor that:
 - owns the iteration loop (calls ``worker.run_once()``), so it can publish
   a liveness heartbeat between iterations — the producer's ``/metrics``
   exposes worker health, not just throughput;
+- publishes a real lifecycle state machine
+  (``starting → ready → draining → dead``, ``serve/protocol.py``): a
+  ``drain()`` call (or SIGTERM via ``consumer.main``) stops the worker
+  leasing new requests, lets active rows finish and ack, then exits
+  cleanly — with a deadline that falls back to abort-with-error so a
+  stuck row can't pin the drain forever;
+- runs a **watchdog thread**: heartbeats publish from the same thread as
+  ``run_once``, so a decode step hung inside the device runtime would
+  look alive right up until it looked dead. The watchdog watches a
+  wall-clock progress stamp from its own thread and, past
+  ``step_timeout_s``, escalates the stall to this loop as a crash
+  (``WatchdogTimeout`` raised into the blocked thread) — the worker
+  restarts and its leases are reaped like any other death;
 - contains crashes: an exception escaping an iteration tears down the
   worker, publishes the failure, and rebuilds from the factory after a
   capped exponential backoff (reset once the worker has been stable);
-- enforces an optional restart budget (``max_restarts``) so a
-  crash-looping model surfaces as a hard failure instead of burning a chip.
+- enforces an optional restart budget (``max_restarts``) as a **sliding
+  window**: the budget counts crashes since the last stable run
+  (``stable_after_s``), so a long-lived worker with occasional faults is
+  never killed by its lifetime total, while a crash loop still surfaces
+  as a hard failure instead of burning a chip.
 """
 
 from __future__ import annotations
 
+import ctypes
 import logging
 import threading
 import time
 from typing import Callable
 
+from llmss_tpu.serve.protocol import (
+    STATE_DEAD,
+    STATE_DRAINING,
+    STATE_READY,
+    STATE_STARTING,
+)
+
 logger = logging.getLogger("llmss_tpu.serve")
+
+
+class WatchdogTimeout(BaseException):
+    """Raised asynchronously into a worker loop whose decode step has made
+    no progress for ``step_timeout_s``. A ``BaseException`` deliberately:
+    the batch worker contains per-batch failures with ``except Exception``
+    so one bad request can't kill its batch-mates — a watchdog escalation
+    must punch through that containment and reach the supervisor, exactly
+    like the chaos harness's ``HardKill``."""
 
 
 class Supervisor:
@@ -36,6 +69,8 @@ class Supervisor:
         backoff_cap_s: float = 60.0,
         stable_after_s: float = 120.0,
         heartbeat_s: float = 5.0,
+        drain_timeout_s: float = 30.0,
+        step_timeout_s: float | None = None,
     ):
         self.worker_factory = worker_factory
         self.broker = broker
@@ -44,8 +79,14 @@ class Supervisor:
         self.backoff_cap_s = backoff_cap_s
         self.stable_after_s = stable_after_s
         self.heartbeat_s = heartbeat_s
+        self.drain_timeout_s = drain_timeout_s
+        # None disables the watchdog (no thread started). When set, a
+        # run_once that stalls past this is escalated as a crash.
+        self.step_timeout_s = step_timeout_s
         self.restarts = 0
         self.alive = False
+        self.state = STATE_STARTING
+        self.watchdog_stalls = 0
         # Current restart delay. Instance state (not a loop local) so tests
         # and operators can observe backoff growth/reset; doubles after each
         # crash, resets to ``backoff_s`` once a worker has run for
@@ -53,19 +94,43 @@ class Supervisor:
         self.backoff_current = backoff_s
         self._last_error: str | None = None
         self._start = time.time()
+        self._drain = threading.Event()
+        self._drain_deadline: float | None = None
+        # Progress stamps: the supervisor stamps between iterations, the
+        # worker stamps inside run_once (per decode chunk). max() of the
+        # two is "the last time this worker demonstrably did anything" —
+        # the watchdog's and the heartbeat's single source of truth.
+        self._progress_ts = time.time()
+        self._worker = None
+        self._loop_ident: int | None = None
+        self._stall_fired = False
+        self._watchdog_stop = threading.Event()
+        self._watchdog_thread: threading.Thread | None = None
         # Merged into EVERY broker publish (worker-side ones included), so
         # the health block can never be erased by a last-write-wins publish.
         broker.metrics_extra = lambda: {"supervisor": self._status()}
 
     # -- status --------------------------------------------------------------
 
+    def _progress_wall(self) -> float:
+        w = self._worker
+        worker_ts = getattr(w, "last_progress_ts", 0.0) if w is not None else 0.0
+        return max(self._progress_ts, worker_ts or 0.0)
+
     def _status(self) -> dict:
         return {
             "alive": self.alive,
+            "state": self.state,
             "restarts": self.restarts,
+            "watchdog_stalls": self.watchdog_stalls,
+            "step_timeout_s": self.step_timeout_s,
             "last_error": self._last_error,
             "uptime_s": round(time.time() - self._start, 1),
-            "heartbeat_ts": round(time.time(), 3),
+            # Progress-based, NOT publish-time: a worker-side publish from
+            # a thread that isn't actually decoding (or a hung step whose
+            # last publish was fresh) must still read as stale at the
+            # producer once nothing has moved for 3× heartbeat_s.
+            "heartbeat_ts": round(self._progress_wall(), 3),
             # Published so health consumers (producer /health) can judge
             # staleness without configuration coupling.
             "heartbeat_s": self.heartbeat_s,
@@ -95,57 +160,201 @@ class Supervisor:
         except Exception:  # noqa: BLE001 — teardown must not mask the crash
             logger.warning("in-flight abort failed", exc_info=True)
 
+    # -- drain ---------------------------------------------------------------
+
+    def drain(self, timeout_s: float | None = None) -> None:
+        """Begin a graceful shutdown (thread-safe; SIGTERM handler calls
+        this). The loop stops leasing new requests, finishes active rows,
+        acks them, and exits with state ``dead``. Past the deadline
+        (``timeout_s``, default ``drain_timeout_s``) never-started requests
+        are released back to the queue for other workers and still-active
+        rows are aborted with an error — a stuck row can't pin the drain."""
+        self._drain_deadline = time.time() + (
+            timeout_s if timeout_s is not None else self.drain_timeout_s
+        )
+        self._drain.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._drain.is_set()
+
+    def _finish_drain(self, worker, clean: bool) -> None:
+        if clean:
+            logger.info("drain complete: worker exited cleanly")
+            return
+        logger.warning(
+            "drain deadline exceeded; releasing pending work and aborting "
+            "active rows"
+        )
+        release = getattr(worker, "release_pending", None)
+        if release is not None:
+            try:
+                n = release()
+                if n:
+                    logger.warning(
+                        "released %d never-started requests to the queue", n
+                    )
+            except Exception:  # noqa: BLE001
+                logger.warning("pending release failed", exc_info=True)
+        self._abort_inflight(worker, "worker draining: drain deadline exceeded")
+
+    # -- watchdog ------------------------------------------------------------
+
+    def _start_watchdog(self) -> None:
+        if self.step_timeout_s is None or self._watchdog_thread is not None:
+            return
+        self._watchdog_stop = threading.Event()
+        t = threading.Thread(
+            target=self._watchdog_loop, name="llmss-watchdog", daemon=True
+        )
+        self._watchdog_thread = t
+        t.start()
+
+    def _stop_watchdog(self) -> None:
+        t = self._watchdog_thread
+        if t is None:
+            return
+        self._watchdog_stop.set()
+        self._watchdog_thread = None
+
+    def _watchdog_loop(self) -> None:
+        stop = self._watchdog_stop
+        poll = max(min(self.step_timeout_s / 4.0, 1.0), 0.01)
+        while not stop.wait(poll):
+            # Only a READY worker can stall: during factory build/prewarm
+            # (minutes of legitimate silence) and backoff, alive is False.
+            if not self.alive or self._stall_fired:
+                continue
+            ident = self._loop_ident
+            stalled_for = time.time() - self._progress_wall()
+            if stalled_for <= self.step_timeout_s or ident is None:
+                continue
+            self._stall_fired = True
+            self.watchdog_stalls += 1
+            self.alive = False
+            self._last_error = (
+                f"watchdog: no decode progress for {stalled_for:.2f}s "
+                f"(step_timeout_s={self.step_timeout_s})"
+            )
+            logger.error("%s — escalating as a crash", self._last_error)
+            # Publish the stall immediately: the loop thread is the one
+            # that's blocked, so it cannot publish its own death.
+            self._publish(self._worker)
+            # Escalate: raise WatchdogTimeout into the blocked loop thread.
+            # Lands at the next bytecode boundary — a hang that sleeps or
+            # loops in Python surfaces within one step; a hang buried in a
+            # single C call surfaces when that call returns. Either way
+            # the producer already sees the stall (stale heartbeat +
+            # alive=False) the moment it's detected.
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_long(ident), ctypes.py_object(WatchdogTimeout)
+            )
+
     # -- loop ----------------------------------------------------------------
 
     def run(self, stop: threading.Event | None = None) -> None:
-        """Supervised serving loop; returns when ``stop`` is set, raises
-        only when the restart budget is exhausted."""
+        """Supervised serving loop; returns when ``stop`` is set or a drain
+        completes, raises only when the restart budget is exhausted."""
         self.backoff_current = self.backoff_s
-        while stop is None or not stop.is_set():
-            worker = None
-            started = time.time()
-            last_beat = 0.0
-            try:
-                # Factory inside the try: a rebuild failure is a crash too
-                # (backoff + budget apply), not a supervisor death.
-                worker = self.worker_factory()
-                self.alive = True
-                while stop is None or not stop.is_set():
-                    worker.run_once()
-                    now = time.time()
-                    if now - last_beat >= self.heartbeat_s:
-                        self._publish(worker)
-                        last_beat = now
-                    if now - started > self.stable_after_s:
-                        self.backoff_current = self.backoff_s
-            except Exception as e:  # noqa: BLE001 — crash containment
-                self.alive = False
-                self.restarts += 1
-                self._last_error = f"{type(e).__name__}: {e}"
-                logger.error(
-                    "worker crashed (%s), restart %d in %.1fs",
-                    self._last_error, self.restarts,
-                    self.backoff_current, exc_info=True,
-                )
-                if worker is not None:
-                    self._abort_inflight(worker, self._last_error)
-                self._publish(worker)
-                if (
-                    self.max_restarts is not None
-                    and self.restarts > self.max_restarts
-                ):
-                    raise RuntimeError(
-                        f"worker exceeded restart budget "
-                        f"({self.max_restarts}); last error: "
-                        f"{self._last_error}"
-                    ) from e
-                if stop is not None:
-                    if stop.wait(self.backoff_current):
+        self._loop_ident = threading.get_ident()
+        self._start_watchdog()
+        try:
+            while stop is None or not stop.is_set():
+                worker = None
+                started = time.time()
+                last_beat = 0.0
+                try:
+                    # Factory inside the try: a rebuild failure is a crash
+                    # too (backoff + budget apply), not a supervisor death.
+                    self.state = STATE_STARTING
+                    self._progress_ts = time.time()
+                    worker = self.worker_factory()
+                    self._worker = worker
+                    self._progress_ts = time.time()
+                    self._stall_fired = False
+                    self.alive = True
+                    self.state = STATE_READY
+                    drain_signaled = False
+                    while stop is None or not stop.is_set():
+                        if self._drain.is_set() and not drain_signaled:
+                            drain_signaled = True
+                            self.state = STATE_DRAINING
+                            begin = getattr(worker, "begin_drain", None)
+                            if begin is not None:
+                                begin()
+                            self._publish(worker)
+                            last_beat = time.time()
+                        worker.run_once()
+                        now = self._progress_ts = time.time()
+                        if now - last_beat >= self.heartbeat_s:
+                            self._publish(worker)
+                            last_beat = now
+                        if now - started > self.stable_after_s:
+                            self.backoff_current = self.backoff_s
+                            # Sliding-window restart budget: stability pays
+                            # back crash history, so max_restarts bounds
+                            # crash *density*, not lifetime totals.
+                            self.restarts = 0
+                        if drain_signaled:
+                            if getattr(worker, "drained", True):
+                                self._finish_drain(worker, clean=True)
+                                return
+                            dl = self._drain_deadline
+                            if dl is not None and now >= dl:
+                                self._finish_drain(worker, clean=False)
+                                return
+                    return  # stop was set inside the inner loop
+                except (WatchdogTimeout, Exception) as e:  # noqa: BLE001
+                    self.alive = False
+                    self.restarts += 1
+                    self._last_error = f"{type(e).__name__}: {e}"
+                    logger.error(
+                        "worker crashed (%s), restart %d in %.1fs",
+                        self._last_error, self.restarts,
+                        self.backoff_current, exc_info=True,
+                    )
+                    if worker is not None:
+                        self._abort_inflight(worker, self._last_error)
+                    self._publish(worker)
+                    if self._drain.is_set():
+                        # Crashing while draining: the point of the drain
+                        # was to take this worker out — don't restart it.
+                        logger.warning(
+                            "crash during drain; exiting without restart"
+                        )
                         return
-                else:
-                    time.sleep(self.backoff_current)
-                self.backoff_current = min(
-                    self.backoff_current * 2, self.backoff_cap_s
-                )
-                continue
-            return  # stop was set inside the inner loop
+                    if (
+                        self.max_restarts is not None
+                        and self.restarts > self.max_restarts
+                    ):
+                        raise RuntimeError(
+                            f"worker exceeded restart budget "
+                            f"({self.max_restarts}); last error: "
+                            f"{self._last_error}"
+                        ) from e
+                    if stop is not None:
+                        if stop.wait(self.backoff_current):
+                            return
+                    else:
+                        time.sleep(self.backoff_current)
+                    self.backoff_current = min(
+                        self.backoff_current * 2, self.backoff_cap_s
+                    )
+                    continue
+        finally:
+            # Terminal no matter how we leave: the state machine may only
+            # end in ``dead``. Publish the death for *lifecycle* exits —
+            # drain complete, budget exhausted, an exception blowing
+            # through — so producers shed on it; an external stop event
+            # (embedding harness teardown) leaves the last live heartbeat
+            # in the channel, since the worker it described ran fine.
+            import sys
+
+            self._stop_watchdog()
+            lifecycle_exit = (
+                self._drain.is_set() or sys.exc_info()[0] is not None
+            )
+            self.alive = False
+            self.state = STATE_DEAD
+            if lifecycle_exit:
+                self._publish(self._worker)

@@ -91,6 +91,7 @@ class EngineMetrics:
         self.errors = 0
         self.cancelled = 0
         self.deadline_expired = 0
+        self.poisoned = 0
         self._start = time.time()
 
     def add_tokens(self, n: int) -> None:
@@ -115,12 +116,18 @@ class EngineMetrics:
         with self._lock:
             self.deadline_expired += n
 
+    def add_poisoned(self, n: int = 1) -> None:
+        """Rows errored out because their logits went non-finite mid-decode
+        (per-row NaN/inf containment — the co-batched rows kept going)."""
+        with self._lock:
+            self.poisoned += n
+
     def to_dict(self) -> dict:
         uptime = time.time() - self._start
         with self._lock:
-            toks, reqs, errs, canc, exp = (
+            toks, reqs, errs, canc, exp, pois = (
                 self.tokens_generated, self.requests_served, self.errors,
-                self.cancelled, self.deadline_expired,
+                self.cancelled, self.deadline_expired, self.poisoned,
             )
         return {
             "uptime_s": round(uptime, 1),
@@ -129,6 +136,7 @@ class EngineMetrics:
             "errors": errs,
             "cancelled": canc,
             "deadline_expired": exp,
+            "poisoned_rows": pois,
             "tokens_per_sec_lifetime": round(toks / uptime, 2) if uptime else 0,
             "ttft": self.ttft.to_dict(),
             "prefill": self.prefill.to_dict(),

@@ -1,0 +1,500 @@
+"""Worker lifecycle: graceful drain, decode watchdog, per-row poison
+containment (ISSUE 2).
+
+The three chaos scenarios here are the acceptance contract for the
+``starting → ready → draining → dead`` state machine in
+``serve/supervisor.py``, each run against BOTH delivery substrates
+(InProcBroker and the real RedisBroker code paths over FakeRedis):
+
+- **drain**: a drain issued mid-load lets every in-flight request finish
+  and ack — zero errors, zero redeliveries — and the worker ends ``dead``;
+- **hang**: a decode step that wedges is detected by the watchdog within
+  ``step_timeout_s``, the worker restarts, and every accepted request
+  still gets exactly one terminal response with the exact payload;
+- **nan**: a row whose logits go non-finite errors out alone, while
+  co-batched rows keep their exact solo tokens.
+"""
+
+import threading
+import time
+
+import pytest
+
+from llmss_tpu.serve.broker import InProcBroker, RedisBroker
+from llmss_tpu.serve.chaos import NAN_TOKEN, FakeRedis, ScriptedEngine
+from llmss_tpu.serve.consumer import Worker
+from llmss_tpu.serve.producer import ProducerServer
+from llmss_tpu.serve.protocol import (
+    STATE_DEAD,
+    STATE_READY,
+    GenerateRequest,
+)
+from llmss_tpu.serve.supervisor import Supervisor
+
+BROKER_KINDS = ("inproc", "fakeredis")
+
+
+def make_brokers(kind, *, lease_s=5.0, max_attempts=6):
+    """(producer_broker, worker_broker) on one substrate."""
+    if kind == "inproc":
+        b = InProcBroker(lease_s=lease_s, max_delivery_attempts=max_attempts)
+        return b, b
+    server = FakeRedis()
+
+    def mk(wid):
+        return RedisBroker(
+            client=server, worker_id=wid, lease_s=lease_s,
+            max_delivery_attempts=max_attempts,
+        )
+
+    return mk("producer"), mk("worker")
+
+
+def collect(broker, reqs, timeout_s, give_up=None):
+    """One waiter per request (the producer pattern). Returns
+    {id: response|'DUPLICATE'}; unanswered ids are absent."""
+    results = {}
+    lock = threading.Lock()
+    deadline = time.time() + timeout_s
+
+    def wait_one(req):
+        while time.time() < deadline:
+            if give_up is not None and give_up.is_set():
+                return
+            resp = broker.wait_response(req.id, timeout=0.2)
+            if resp is None:
+                continue
+            with lock:
+                results[req.id] = resp
+            dup = broker.wait_response(req.id, timeout=0.2)
+            if dup is not None:
+                with lock:
+                    results[req.id] = "DUPLICATE"
+            return
+
+    threads = [
+        threading.Thread(target=wait_one, args=(r,), daemon=True)
+        for r in reqs
+    ]
+    for t in threads:
+        t.start()
+    return results, threads
+
+
+def push_requests(broker, n, *, max_new_tokens=4, first_token=1):
+    reqs = [
+        GenerateRequest(
+            token_ids=[first_token + i], max_new_tokens=max_new_tokens,
+            deadline_ts=time.time() + 60.0,
+        )
+        for i in range(n)
+    ]
+    for r in reqs:
+        broker.push_request(r)
+    return reqs
+
+
+# -- acceptance (a): drain under load ---------------------------------------
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_drain_under_load_completes_inflight_cleanly(kind):
+    prod, wb = make_brokers(kind)
+    engine = ScriptedEngine(chunk_delay_s=0.03)
+
+    def factory():
+        return Worker(
+            engine, wb, batch_size=2, poll_timeout_s=0.02, pad_batch=False,
+            chunk_steps=4,
+        )
+
+    sup = Supervisor(factory, wb, backoff_s=0.01, heartbeat_s=0.05)
+    reqs = push_requests(prod, 16, max_new_tokens=16)
+    stop = threading.Event()
+    t = threading.Thread(target=sup.run, args=(stop,), daemon=True)
+    t.start()
+
+    give_up = threading.Event()
+    results, waiters = collect(prod, reqs, timeout_s=30.0, give_up=give_up)
+    deadline = time.time() + 20.0
+    while len(results) < 2 and time.time() < deadline:
+        time.sleep(0.005)
+    assert len(results) >= 2, "no load was served before the drain"
+    sup.drain(timeout_s=10.0)
+    t.join(timeout=20.0)
+    assert not t.is_alive(), "drain did not complete"
+    time.sleep(0.3)  # let terminal responses already pushed land
+    give_up.set()
+    for w in waiters:
+        w.join(timeout=5.0)
+
+    # Everything answered was answered exactly once, cleanly, with the
+    # exact scripted payload — the drain produced no errors.
+    answered = 0
+    for r in reqs:
+        got = results.get(r.id)
+        if got is None:
+            continue  # still queued at drain time: expected, not an error
+        assert got != "DUPLICATE", f"{r.id} answered twice"
+        assert not got.error, f"{r.id} errored during drain: {got.error}"
+        assert got.token_ids == ScriptedEngine.expected_tokens(
+            list(r.token_ids), r.max_new_tokens
+        )
+        answered += 1
+    assert answered >= 2
+    stats = prod.delivery_stats()
+    assert stats.get("redelivered", 0) == 0
+    assert stats.get("inflight", 0) == 0  # nothing left holding a lease
+    # Unanswered requests are still queued for another worker, not lost.
+    assert prod.queue_depth() == len(reqs) - answered
+    # Terminal lifecycle state is published through the health channel.
+    assert sup.state == STATE_DEAD
+    m = prod.read_metrics()["supervisor"]
+    assert m["state"] == STATE_DEAD and m["alive"] is False
+
+
+# -- acceptance (b): hang → watchdog → restart ------------------------------
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_hang_detected_and_every_request_answered_once(kind):
+    prod, wb = make_brokers(kind, lease_s=0.4, max_attempts=10)
+    # ONE engine across restarts: generate call #2 wedges (30 s — only the
+    # watchdog can end it), every other call is instant.
+    engine = ScriptedEngine(hang_at=2, hang_s=30.0)
+
+    def factory():
+        return Worker(
+            engine, wb, batch_size=2, poll_timeout_s=0.02, pad_batch=False,
+        )
+
+    sup = Supervisor(
+        factory, wb, backoff_s=0.01, heartbeat_s=0.05, step_timeout_s=0.3,
+    )
+    reqs = push_requests(prod, 8)
+    stop = threading.Event()
+    t = threading.Thread(target=sup.run, args=(stop,), daemon=True)
+    t.start()
+    t_start = time.time()
+
+    results, waiters = collect(prod, reqs, timeout_s=30.0)
+    for w in waiters:
+        w.join(timeout=35.0)
+    detect_latency = None
+    if sup.watchdog_stalls:
+        detect_latency = time.time() - t_start
+    stop.set()
+    t.join(timeout=10.0)
+
+    assert sup.watchdog_stalls == 1, "watchdog never detected the hang"
+    assert sup.restarts >= 1, "worker was not restarted after the stall"
+    assert "watchdog" in (sup._last_error or "") or sup.restarts >= 1
+    # Detection must be watchdog-speed (step_timeout_s), not hang_s-speed:
+    # the full run — serve, detect, restart, redeliver, finish — fits in a
+    # small multiple of step_timeout_s, nowhere near the 30 s hang.
+    assert detect_latency is not None and detect_latency < 10.0
+    # Exactly one terminal response per accepted request, exact payloads:
+    # the hung batch's leases expired and were redelivered to the rebuilt
+    # worker.
+    for r in reqs:
+        got = results.get(r.id)
+        assert got is not None, f"{r.id} never answered after the hang"
+        assert got != "DUPLICATE", f"{r.id} answered twice"
+        assert not got.error, f"{r.id}: {got.error}"
+        assert got.token_ids == ScriptedEngine.expected_tokens(
+            list(r.token_ids), r.max_new_tokens
+        )
+    stats = prod.delivery_stats()
+    assert stats.get("redelivered", 0) >= 1, "hung leases never redelivered"
+
+
+# -- acceptance (c): NaN row poisoned, batch-mates exact --------------------
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_nan_row_errors_alone_batchmates_keep_solo_tokens(kind):
+    prod, wb = make_brokers(kind)
+    engine = ScriptedEngine(nan_at=1)
+    worker = Worker(
+        engine, wb, batch_size=2, poll_timeout_s=0.05, pad_batch=False,
+    )
+    bad = GenerateRequest(id="bad", token_ids=[NAN_TOKEN], max_new_tokens=4)
+    good = GenerateRequest(id="good", token_ids=[7], max_new_tokens=4)
+    prod.push_request(bad)
+    prod.push_request(good)
+    worker.run_once()  # one co-batched generate call
+
+    bresp = prod.wait_response("bad", timeout=5)
+    gresp = prod.wait_response("good", timeout=5)
+    assert bresp is not None and bresp.error
+    assert "poisoned" in bresp.error
+    assert gresp is not None and not gresp.error
+    assert gresp.token_ids == ScriptedEngine.expected_tokens([7], 4)
+    assert engine.metrics.to_dict()["poisoned_rows"] == 1
+
+
+# -- satellite 3: hung run_once flips producer /health ----------------------
+
+
+def test_hung_run_once_flips_health_503_within_3x_heartbeat():
+    """The heartbeat is progress-stamped, so a run_once wedged inside the
+    engine goes stale at the producer within 3× heartbeat_s even though
+    the supervisor thread (the one that publishes) is blocked — no
+    watchdog needed for visibility."""
+    b = InProcBroker()
+    engine = ScriptedEngine(hang_at=1, hang_s=2.0)
+
+    def factory():
+        return Worker(
+            engine, b, batch_size=1, poll_timeout_s=0.01, pad_batch=False,
+        )
+
+    sup = Supervisor(factory, b, backoff_s=0.01, heartbeat_s=0.1)
+    srv = ProducerServer(b, host="127.0.0.1", port=0)
+    stop = threading.Event()
+    t = threading.Thread(target=sup.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            code, body = srv.health()
+            if code == 200 and body.get("state") == STATE_READY:
+                break
+            time.sleep(0.01)
+        assert code == 200, f"worker never became healthy: {body}"
+
+        # This request's generate call wedges for 2 s with no progress.
+        b.push_request(GenerateRequest(id="h", token_ids=[1],
+                                       max_new_tokens=2))
+        t0 = time.time()
+        code = 200
+        while time.time() - t0 < 3.0:
+            code, body = srv.health()
+            if code == 503:
+                break
+            time.sleep(0.01)
+        flipped_after = time.time() - t0
+        assert code == 503, "health never flipped on the hung step"
+        assert body["status"] == "stale-heartbeat"
+        # 3 × heartbeat_s = 0.3 s staleness threshold; the flip lands
+        # shortly after it, long before the 2 s hang resolves.
+        assert flipped_after < 1.5
+    finally:
+        stop.set()
+        t.join(timeout=10.0)
+
+
+# -- satellite 1: sliding-window restart budget -----------------------------
+
+
+def test_restart_budget_is_sliding_window():
+    """``max_restarts`` bounds crash *density* (crashes since the last
+    stable run), not the lifetime total: with stability between crashes the
+    budget never exhausts, while the same schedule without stability resets
+    raises."""
+
+    def run_schedule(stable_after_s):
+        calls = {"n": 0}
+        stop = threading.Event()
+
+        class W:
+            def run_once(self):
+                calls["n"] += 1
+                if calls["n"] >= 9:
+                    stop.set()
+                    return
+                if calls["n"] % 2 == 0:
+                    raise RuntimeError(f"crash@{calls['n']}")
+
+        sup = Supervisor(
+            W, InProcBroker(), backoff_s=0.0, max_restarts=2,
+            stable_after_s=stable_after_s, heartbeat_s=0.0,
+        )
+        sup.run(stop)
+        return sup
+
+    # Crash every other call, but each intervening success counts as a
+    # stable run (stable_after_s=0): 4 lifetime crashes never exceed the
+    # budget of 2.
+    sup = run_schedule(stable_after_s=0.0)
+    assert sup.restarts <= 1
+
+    # The same schedule with no stability credit exhausts the budget on
+    # the third crash.
+    with pytest.raises(RuntimeError, match="restart budget"):
+        run_schedule(stable_after_s=3600.0)
+
+
+# -- real-engine lifecycle paths (continuous batching) ----------------------
+
+
+@pytest.fixture(scope="module")
+def small_engine(devices):
+    import jax
+
+    from llmss_tpu.engine import DecodeEngine
+    from llmss_tpu.models.common import DecoderConfig
+    from llmss_tpu.models.decoder import init_params
+    from llmss_tpu.parallel import MeshPlan, make_mesh
+
+    mesh = make_mesh(MeshPlan(dp=1, sp=1, tp=8))
+    cfg = DecoderConfig(
+        model_type="llama", vocab_size=128, hidden_size=32, n_layers=1,
+        n_heads=4, n_kv_heads=4, head_dim=8, intermediate_size=64,
+        max_position_embeddings=64, activation="silu", norm="rmsnorm",
+        norm_eps=1e-5, mlp="swiglu", positions="rotary", rope_style="half",
+        rotary_dim=8, attn_bias=False, mlp_bias=False,
+        tie_word_embeddings=False, dtype="float32",
+    )
+    params = init_params(cfg, mesh, jax.random.key(0))
+    return DecodeEngine(cfg, params, mesh, max_seq_len=64)
+
+
+def test_continuous_worker_drains_active_rows(small_engine):
+    """Clean drain with real decode in flight: the active row finishes and
+    acks; the worker reports drained only once the batcher is idle."""
+    from llmss_tpu.serve.consumer import ContinuousWorker
+
+    b = InProcBroker()
+    w = ContinuousWorker(small_engine, b, tokenizer=None, rows=2)
+    b.push_request(GenerateRequest(
+        id="rq", token_ids=[1, 2, 3], max_new_tokens=20, is_greedy=True,
+    ))
+    w.run_once()  # admits; far from finished
+    w.begin_drain()
+    assert not w.drained  # active row still decoding
+    for _ in range(200):
+        if w.drained:
+            break
+        w.run_once()
+    assert w.drained
+    resp = b.wait_response("rq", timeout=5)
+    assert resp is not None and not resp.error
+    assert len(resp.token_ids) == 20
+
+
+def test_release_pending_requeues_unstarted_requests(small_engine):
+    """Drain-deadline fallback: requests the device never touched go back
+    to the broker queue with their delivery attempt refunded; active rows
+    are aborted with an error (every client gets exactly one answer)."""
+    from llmss_tpu.serve.consumer import ContinuousWorker
+
+    b = InProcBroker()
+    w = ContinuousWorker(small_engine, b, tokenizer=None, rows=1)
+    b.push_request(GenerateRequest(
+        id="active", token_ids=[1, 2], max_new_tokens=20, is_greedy=True,
+    ))
+    b.push_request(GenerateRequest(
+        id="queued", token_ids=[3, 4], max_new_tokens=20, is_greedy=True,
+    ))
+    w.run_once()  # leases both; admits "active" (rows=1), "queued" pends
+    assert w.release_pending() == 1
+    assert b.queue_depth() == 1
+    n = w.abort_inflight("worker draining: drain deadline exceeded")
+    assert n == 1
+    aresp = b.wait_response("active", timeout=5)
+    assert aresp is not None and "drain deadline exceeded" in aresp.error
+    # The released request is deliverable again, with its delivery attempt
+    # refunded — the drain bounce doesn't count toward dead-lettering.
+    req2 = b.pop_request(timeout=1.0)
+    assert req2 is not None and req2.id == "queued"
+    assert req2.delivery_attempts == 1
+
+
+def test_scheduler_poisons_row_without_touching_batchmates(small_engine):
+    """Per-row containment on the continuous path: a poisoned flag for one
+    row errors only that row; the co-batched row's tokens are exactly its
+    solo tokens."""
+    from llmss_tpu.engine import GenerationParams
+    from llmss_tpu.engine.scheduler import ContinuousBatcher
+
+    gp = GenerationParams(max_new_tokens=8, is_greedy=True)
+    solo = small_engine.generate([[5, 6, 7]], gp)[0]
+
+    batcher = ContinuousBatcher(small_engine, rows=2, chunk_steps=2)
+    orig = small_engine._decode_many
+
+    def poisoning(*a, **k):
+        toks, cache, cur_pos, done, poisoned = orig(*a, **k)
+        bad_row = next(
+            (row for row, r in batcher.active.items()
+             if r.req_id == "bad" and not r.awaiting_first),
+            None,
+        )
+        if bad_row is not None:
+            poisoned = poisoned.at[bad_row].set(True)
+        return toks, cache, cur_pos, done, poisoned
+
+    small_engine._decode_many = poisoning
+    try:
+        done = {}
+
+        def cb_for(name):
+            def cb(toks, cancelled=False, error=None):
+                done[name] = (list(toks), error)
+            return cb
+
+        batcher.submit([5, 6, 7], GenerationParams(
+            max_new_tokens=8, is_greedy=True), cb_for("good"),
+            req_id="good")
+        batcher.submit([9, 9], GenerationParams(
+            max_new_tokens=8, is_greedy=True), cb_for("bad"), req_id="bad")
+        for _ in range(100):
+            if len(done) == 2:
+                break
+            batcher.step()
+    finally:
+        small_engine._decode_many = orig
+
+    assert "poisoned" in (done["bad"][1] or "")
+    good_toks, good_err = done["good"]
+    assert good_err is None
+    assert good_toks == solo, "poison leaked into a batch-mate's tokens"
+    assert small_engine.metrics.to_dict()["poisoned_rows"] >= 1
+
+
+def test_engine_generate_reports_poisoned_rows(small_engine):
+    """Batch path plumbing: a poisoned flag from the fused decode surfaces
+    through ``on_poisoned`` and never reads as a clean success."""
+    from llmss_tpu.engine import GenerationParams
+
+    gp = GenerationParams(max_new_tokens=6, is_greedy=True)
+    solo = small_engine.generate([[11, 12]], gp)[0]
+
+    orig = small_engine._decode_many
+
+    def poisoning(*a, **k):
+        toks, cache, cur_pos, done, poisoned = orig(*a, **k)
+        return toks, cache, cur_pos, done, poisoned.at[0].set(True)
+
+    flagged = set()
+    small_engine._decode_many = poisoning
+    try:
+        outs = small_engine.generate(
+            [[3, 4], [11, 12]],
+            [GenerationParams(max_new_tokens=6, is_greedy=True),
+             GenerationParams(max_new_tokens=6, is_greedy=True)],
+            on_poisoned=flagged.add,
+            chunk_steps=2,  # the chunked (serving) path carries the flag
+        )
+    finally:
+        small_engine._decode_many = orig
+    assert flagged == {0}
+    assert outs[1] == solo, "poison leaked into a batch-mate's tokens"
+
+
+def test_nonfinite_rows_unit():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llmss_tpu.ops.sampling import nonfinite_rows
+
+    logits = jnp.asarray([
+        [0.1, 0.2, 0.3],
+        [0.1, jnp.nan, 0.3],
+        [jnp.inf, 0.2, 0.3],
+        [-jnp.inf, 0.2, 0.3],
+    ])
+    np.testing.assert_array_equal(
+        np.asarray(nonfinite_rows(logits)), [False, True, True, True]
+    )

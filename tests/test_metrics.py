@@ -25,6 +25,38 @@ def test_engine_metrics_shape():
     assert d["requests_served"] == 2
     assert d["tokens_generated"] == 10
     assert d["ttft"]["count"] == 1
+    assert d["poisoned_rows"] == 0
+
+
+def test_poisoned_row_counter():
+    m = EngineMetrics()
+    m.add_poisoned()
+    m.add_poisoned(2)
+    assert m.to_dict()["poisoned_rows"] == 3
+
+
+def test_supervisor_lifecycle_fields_exported():
+    """The health channel carries the lifecycle state machine: state,
+    watchdog stall count, and the watchdog config ride every publish (the
+    producer's /health and /metrics read them from here)."""
+    from llmss_tpu.serve.broker import InProcBroker
+    from llmss_tpu.serve.protocol import STATE_STARTING, WORKER_STATES
+    from llmss_tpu.serve.supervisor import Supervisor
+
+    b = InProcBroker()
+    sup = Supervisor(
+        lambda: None, b, heartbeat_s=0.0, step_timeout_s=12.5,
+    )
+    b.publish_metrics({})
+    s = b.read_metrics()["supervisor"]
+    assert s["state"] == STATE_STARTING
+    assert s["state"] in WORKER_STATES
+    assert s["watchdog_stalls"] == 0
+    assert s["step_timeout_s"] == 12.5
+    assert "heartbeat_ts" in s and "heartbeat_s" in s
+    sup.watchdog_stalls += 1
+    b.publish_metrics({})
+    assert b.read_metrics()["supervisor"]["watchdog_stalls"] == 1
 
 
 def test_engine_records_metrics(tmp_path, devices):

@@ -166,7 +166,9 @@ def _paid_backoffs(stable_after_s):
         backoff_s=0.01, stable_after_s=stable_after_s, heartbeat_s=0.0,
     )
     _run_until(sup, 6, record)
-    assert sup.restarts == 2
+    # Both scheduled crashes happened (restart count itself is stability-
+    # dependent now: a stable run resets it along with the backoff).
+    assert record.count("built") == 3
     return paid, broker
 
 

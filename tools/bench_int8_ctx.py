@@ -67,7 +67,7 @@ def step_ms_for(engine, cfg, batch) -> float:
             cache, tok, cur = state["cache"], tok0, cur0
             total = jnp.zeros((), jnp.int32)
             for k, tb in sched:
-                toks, cache, cur, _ = engine._decode_many(
+                toks, cache, cur, _, _ = engine._decode_many(
                     engine.params, tok, cache, cur, sa, done, eos,
                     n_steps=k, t_bucket=tb,
                 )

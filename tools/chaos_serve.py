@@ -19,6 +19,9 @@ Examples::
         --kill-prob 0.2 --drop-response-prob 0.1
     python tools/chaos_serve.py --broker fakeredis --poison 2 \
         --max-attempts 3
+    python tools/chaos_serve.py --fault drain   # lifecycle scenarios:
+    python tools/chaos_serve.py --fault hang    #   supervised worker +
+    python tools/chaos_serve.py --fault nan     #   scripted failure
 
 Prints a one-line JSON delivery report.
 """
@@ -36,10 +39,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from llmss_tpu.serve.broker import InProcBroker, RedisBroker  # noqa: E402
 from llmss_tpu.serve.chaos import (  # noqa: E402
-    POISON_TOKEN, ChaosBroker, ChaosWorkerHost, FakeRedis, ScriptedEngine,
+    NAN_TOKEN, POISON_TOKEN, ChaosBroker, ChaosWorkerHost, FakeRedis,
+    ScriptedEngine,
 )
 from llmss_tpu.serve.consumer import Worker  # noqa: E402
 from llmss_tpu.serve.protocol import GenerateRequest  # noqa: E402
+from llmss_tpu.serve.supervisor import Supervisor  # noqa: E402
 
 
 def build_brokers(args):
@@ -58,6 +63,165 @@ def build_brokers(args):
         )
 
     return mk("producer"), [mk(f"worker{i}") for i in range(args.workers)]
+
+
+def run_fault(args):
+    """Deterministic single-worker lifecycle scenarios (``--fault``).
+
+    Unlike the random kill/drop fleet, these drive ONE supervised worker
+    through a scripted failure and audit the lifecycle contract:
+
+    - ``drain``:  drain mid-load; every response so far is clean, nothing
+      was redelivered, and the supervisor lands in state ``dead``.
+    - ``hang``:   the engine wedges on one generate call; the watchdog must
+      detect it, restart the worker, and every request still gets exactly
+      one terminal response with the exact scripted payload.
+    - ``nan``:    rows carrying ``NAN_TOKEN`` go non-finite; only those rows
+      error while co-batched requests keep their exact solo tokens.
+    """
+    args.workers = 1
+    prod_broker, (wb,) = build_brokers(args)
+
+    engine_kwargs = {}
+    if args.fault == "hang":
+        engine_kwargs = {"hang_at": 3, "hang_s": args.deadline_s}
+    elif args.fault == "nan":
+        engine_kwargs = {"nan_at": 1}
+    # One engine shared across supervised restarts so a scripted hang
+    # fires exactly once (the rebuilt worker must make progress).
+    engine = ScriptedEngine(**engine_kwargs)
+
+    def factory():
+        return Worker(
+            engine, wb, batch_size=args.batch_size, poll_timeout_s=0.02,
+            pad_batch=False,
+        )
+
+    sup = Supervisor(
+        factory, wb, backoff_s=0.01, heartbeat_s=0.05,
+        step_timeout_s=0.5 if args.fault == "hang" else None,
+        drain_timeout_s=10.0,
+    )
+
+    n_poison = args.poison
+    if args.fault == "nan" and n_poison == 0:
+        n_poison = max(1, args.requests // 4)
+    reqs = []
+    for i in range(args.requests):
+        prompt = [NAN_TOKEN, i + 1] if i < n_poison else [i % 1000 + 1]
+        reqs.append(GenerateRequest(
+            token_ids=prompt, max_new_tokens=4,
+            deadline_ts=time.time() + args.deadline_s,
+        ))
+    for r in reqs:
+        prod_broker.push_request(r)
+
+    stop = threading.Event()
+    sup_thread = threading.Thread(
+        target=sup.run, args=(stop,), daemon=True
+    )
+    sup_thread.start()
+
+    results: dict[str, object] = {}
+    lock = threading.Lock()
+    give_up = threading.Event()
+    hard_deadline = time.time() + args.deadline_s
+
+    def wait_one(req):
+        while not give_up.is_set() and time.time() < hard_deadline:
+            resp = prod_broker.wait_response(req.id, timeout=0.2)
+            if resp is None:
+                continue
+            with lock:
+                results[req.id] = resp
+            dup = prod_broker.wait_response(req.id, timeout=0.2)
+            if dup is not None:
+                with lock:
+                    results[req.id] = "DUPLICATE"
+            return
+
+    waiters = [
+        threading.Thread(target=wait_one, args=(r,), daemon=True)
+        for r in reqs
+    ]
+    for t in waiters:
+        t.start()
+
+    if args.fault == "drain":
+        # Let some of the load complete, then drain mid-stream.
+        threshold = max(1, args.requests // 3)
+        while time.time() < hard_deadline:
+            with lock:
+                if len(results) >= threshold:
+                    break
+            time.sleep(0.01)
+        sup.drain(timeout_s=10.0)
+        sup_thread.join(timeout=args.deadline_s)
+        time.sleep(0.3)  # let in-flight terminal responses land
+        give_up.set()
+    else:
+        while time.time() < hard_deadline:
+            with lock:
+                if len(results) == args.requests:
+                    break
+            time.sleep(0.02)
+        stop.set()
+        sup_thread.join(timeout=10.0)
+        give_up.set()
+    for t in waiters:
+        t.join(timeout=5.0)
+
+    # -- audit ---------------------------------------------------------------
+    lost, dup, wrong, bad_error, ok, errored = [], [], [], [], 0, 0
+    for i, r in enumerate(reqs):
+        got = results.get(r.id)
+        poisoned = args.fault == "nan" and i < n_poison
+        if got is None:
+            lost.append(r.id)
+        elif got == "DUPLICATE":
+            dup.append(r.id)
+        elif got.error:
+            errored += 1
+            if not poisoned or "poisoned" not in got.error:
+                bad_error.append(r.id)
+        elif poisoned:
+            bad_error.append(r.id)  # poisoned row must not look clean
+        elif got.token_ids != ScriptedEngine.expected_tokens(
+            list(r.token_ids), r.max_new_tokens
+        ):
+            wrong.append(r.id)
+        else:
+            ok += 1
+
+    stats = prod_broker.delivery_stats()
+    report = {
+        "fault": args.fault,
+        "requests": args.requests,
+        "ok": ok,
+        "errored": errored,
+        "unanswered": len(lost),
+        "duplicates": len(dup),
+        "wrong_payload": len(wrong),
+        "bad_error": len(bad_error),
+        "restarts": sup.restarts,
+        "watchdog_stalls": sup.watchdog_stalls,
+        "state": sup.state,
+        "delivery": stats,
+    }
+    print(json.dumps(report))
+
+    violations = bool(dup or wrong or bad_error)
+    if args.fault == "drain":
+        # Everything answered before/through the drain must be clean and
+        # delivered once; requests still queued at drain are expected to go
+        # unanswered here, not errored.
+        violations |= errored > 0 or stats.get("redelivered", 0) > 0
+        violations |= sup.state != "dead"
+    elif args.fault == "hang":
+        violations |= bool(lost) or sup.watchdog_stalls < 1
+    elif args.fault == "nan":
+        violations |= bool(lost) or errored != n_poison
+    return 1 if violations else 0
 
 
 def main(argv=None):
@@ -81,7 +245,13 @@ def main(argv=None):
     p.add_argument("--deadline-s", type=float, default=60.0,
                    help="end-to-end deadline stamped on every request")
     p.add_argument("--batch-size", type=int, default=1)
+    p.add_argument("--fault", choices=("drain", "hang", "nan"), default=None,
+                   help="run a deterministic single-worker lifecycle "
+                        "scenario instead of the random kill/drop fleet")
     args = p.parse_args(argv)
+
+    if args.fault is not None:
+        return run_fault(args)
 
     prod_broker, worker_brokers = build_brokers(args)
 

@@ -54,7 +54,7 @@ def run(variant, use_bucket, timing=False):
     t0 = time.perf_counter()
     stamps = []
     if variant == "single":
-        toks, cache, cur, _ = engine._decode_many(
+        toks, cache, cur, _, _ = engine._decode_many(
             engine.params, tok, cache, cur, sa, done, eos,
             n_steps=CHUNK * N_CHUNKS,
             t_bucket=None,
@@ -65,7 +65,7 @@ def run(variant, use_bucket, timing=False):
         total = jnp.zeros((), jnp.int32)
         for _ in range(N_CHUNKS):
             tb = engine.decode_bucket(pos + CHUNK) if use_bucket else None
-            toks, cache, cur, _ = engine._decode_many(
+            toks, cache, cur, _, _ = engine._decode_many(
                 engine.params, tok, cache, cur, sa, done, eos,
                 n_steps=CHUNK, t_bucket=tb,
             )
