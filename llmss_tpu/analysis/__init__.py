@@ -1,0 +1,23 @@
+"""graftlint: project-native static analysis for JAX tracing hazards and
+lock discipline.
+
+The reference llmss ships with no correctness tooling at all; this package
+is the repo's blocking lint gate.  Two rule families:
+
+* **JAX rules** (``jax_rules.py``) — host syncs inside jitted functions,
+  ``if`` on tracers, jit construction inside loops, dynamic
+  ``static_argnums``, missing ``donate_argnums`` on cache-threading jits,
+  and wall-clock (``time.time()``) used where a monotonic clock is required.
+* **Concurrency rules** (``concurrency.py``) — ``# guarded_by: <lock>``
+  annotations on shared mutable attributes with every write site proven to
+  be inside ``with <lock>:``, plus lock-acquisition-order cycle detection.
+
+Run it with ``python -m llmss_tpu.analysis llmss_tpu`` (or ``tools/lint.py``).
+``CompileGuard`` (``compile_guard.py``) is the runtime twin: it asserts zero
+steady-state recompiles in engine tests.
+"""
+
+from .compile_guard import CompileGuard
+from .findings import Baseline, Finding, collect_suppressions
+
+__all__ = ["Baseline", "CompileGuard", "Finding", "collect_suppressions"]

@@ -1,0 +1,151 @@
+"""graftlint CLI.
+
+Usage::
+
+    python -m llmss_tpu.analysis PATH [PATH ...]
+        [--baseline tools/lint_baseline.json] [--write-baseline] [--list-rules]
+
+Exit codes: 0 = clean (or everything baselined/suppressed), 1 = findings,
+2 = usage or parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import sys
+from pathlib import Path
+
+from . import concurrency, jax_rules
+from .findings import Baseline, Finding, collect_suppressions, is_suppressed
+
+RULES = {
+    "jit-host-sync": "host transfer on a traced value inside a jitted fn",
+    "jit-if-on-tracer": "python `if` on a traced value inside a jitted fn",
+    "host-sync-in-loop": "device fetch inside a host-side python loop",
+    "jit-in-loop": "jax.jit constructed inside a loop body",
+    "jit-dynamic-static-args": "non-literal static_argnums/static_argnames",
+    "jit-missing-donate": "cache-threading jit without donate_argnums",
+    "wall-clock-timer": "time.time() used for a duration/timeout",
+    "unguarded-write": "write to a `# guarded_by:` attr outside its lock",
+    "lock-order-cycle": "cycle in the lock-acquisition-order graph",
+}
+
+
+def iter_py_files(paths: list[str]) -> list[Path]:
+    out: list[Path] = []
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            out.extend(sorted(path.rglob("*.py")))
+        elif path.suffix == ".py":
+            out.append(path)
+    return out
+
+
+def run(
+    paths: list[str],
+    baseline_path: str | None = None,
+    write_baseline: bool = False,
+) -> tuple[int, list[Finding]]:
+    """Lint ``paths``; returns (exit_code, reportable findings)."""
+    files = iter_py_files(paths)
+    if not files:
+        print(f"graftlint: no python files under {paths}", file=sys.stderr)
+        return 2, []
+
+    modules: list[tuple[str, ast.Module, str]] = []
+    for f in files:
+        source = f.read_text()
+        try:
+            tree = ast.parse(source, filename=str(f))
+        except SyntaxError as e:
+            print(f"graftlint: cannot parse {f}: {e}", file=sys.stderr)
+            return 2, []
+        modules.append((f.as_posix(), tree, source))
+
+    registry = jax_rules.collect_jit_registry(
+        [(path, tree) for path, tree, _ in modules]
+    )
+
+    findings: list[Finding] = []
+    edges: list[concurrency.LockEdge] = []
+    suppressions = {path: collect_suppressions(src) for path, _, src in modules}
+    for path, tree, source in modules:
+        findings.extend(jax_rules.check_module(path, tree, registry))
+        conc, mod_edges = concurrency.check_module(path, tree, source)
+        findings.extend(conc)
+        edges.extend(mod_edges)
+    findings.extend(concurrency.detect_cycles(edges))
+
+    findings = [
+        f for f in findings
+        if not is_suppressed(f, suppressions.get(f.path, {}))
+    ]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    if write_baseline:
+        target = baseline_path or "tools/lint_baseline.json"
+        Baseline().write(target, findings)
+        print(f"graftlint: wrote {len(findings)} finding(s) to {target}")
+        return 0, findings
+
+    baseline = Baseline.load(baseline_path) if baseline_path else Baseline()
+    new = [f for f in findings if f not in baseline]
+    for f in new:
+        print(f.render())
+    baselined = len(findings) - len(new)
+    if new:
+        print(
+            f"graftlint: {len(new)} finding(s)"
+            + (f" ({baselined} baselined)" if baselined else "")
+        )
+        return 1, new
+    print(
+        "graftlint: clean"
+        + (f" ({baselined} baselined finding(s))" if baselined else "")
+    )
+    return 0, []
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m llmss_tpu.analysis",
+        description="graftlint: JAX tracing-hazard and lock-discipline lint",
+    )
+    parser.add_argument("paths", nargs="*", help="files or directories to lint")
+    parser.add_argument(
+        "--baseline",
+        default="tools/lint_baseline.json",
+        help="baseline JSON of accepted findings (default: %(default)s; "
+        "missing file = empty baseline)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline and report every finding",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept all current findings into the baseline file and exit 0",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        width = max(len(r) for r in RULES)
+        for rule, desc in RULES.items():
+            print(f"{rule:<{width}}  {desc}")
+        return 0
+
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        return 2
+
+    code, _ = run(
+        args.paths,
+        baseline_path=None if args.no_baseline else args.baseline,
+        write_baseline=args.write_baseline,
+    )
+    return code

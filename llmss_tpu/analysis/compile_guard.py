@@ -1,0 +1,77 @@
+"""Runtime recompile guard — the dynamic twin of the static shape rules.
+
+``jax.jit`` silently recompiles whenever a call arrives with a new
+shape/dtype/static-argument signature; in steady-state serving that is a
+multi-second stall per occurrence.  ``CompileGuard`` snapshots the compile
+-cache size of each jitted callable and asserts it has not grown::
+
+    guard = CompileGuard.for_engine(engine)
+    engine.generate(prompts, gen)   # warmup: compiles are expected
+    guard.snapshot()
+    engine.generate(prompts, gen)   # steady state
+    guard.assert_no_recompiles()
+
+It relies on the private-but-stable ``_cache_size()`` accessor on jitted
+callables; callables without it are skipped, so the guard degrades to a
+no-op rather than breaking on a jax upgrade.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Iterator
+
+
+class CompileGuard:
+    """Asserts zero steady-state recompiles across a set of jitted fns."""
+
+    def __init__(self, fns: dict[str, Any]):
+        self._fns = {
+            name: fn
+            for name, fn in fns.items()
+            if hasattr(fn, "_cache_size")
+        }
+        self._baseline: dict[str, int] = {}
+        self.snapshot()
+
+    @classmethod
+    def for_engine(cls, engine: Any) -> "CompileGuard":
+        """Discover every jitted callable hanging off an engine instance."""
+        fns = {
+            name: fn
+            for name, fn in vars(engine).items()
+            if hasattr(fn, "_cache_size")
+        }
+        return cls(fns)
+
+    def snapshot(self) -> None:
+        """Record current compile-cache sizes as the steady-state baseline."""
+        self._baseline = {
+            name: fn._cache_size() for name, fn in self._fns.items()
+        }
+
+    def recompiles(self) -> dict[str, tuple[int, int]]:
+        """Map fn name -> (baseline, current) for fns whose cache grew."""
+        out = {}
+        for name, fn in self._fns.items():
+            now = fn._cache_size()
+            was = self._baseline.get(name, 0)
+            if now > was:
+                out[name] = (was, now)
+        return out
+
+    def assert_no_recompiles(self) -> None:
+        grew = self.recompiles()
+        if grew:
+            detail = ", ".join(
+                f"{name}: {was} -> {now} cache entries"
+                for name, (was, now) in sorted(grew.items())
+            )
+            raise AssertionError(f"steady-state recompile detected: {detail}")
+
+    @contextlib.contextmanager
+    def steady_state(self) -> Iterator["CompileGuard"]:
+        """Context manager form: snapshot on entry, assert on clean exit."""
+        self.snapshot()
+        yield self
+        self.assert_no_recompiles()

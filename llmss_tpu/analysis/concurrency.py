@@ -1,0 +1,303 @@
+"""Lock-discipline rules.
+
+**Annotation convention** — a shared mutable attribute declares its lock with
+a trailing comment on its ``__init__`` assignment (or a comment-only line
+directly above it)::
+
+    self._leases = {}  # guarded_by: self._lease_lock
+
+The analyzer then proves every *write* site for that attribute — rebinding,
+augmented assignment, subscript stores/deletes, and mutating method calls
+(``append``/``pop``/``update``/…) — is lexically inside ``with <lock>:``.
+Writes inside ``__init__`` are exempt (the object is not shared yet).
+
+**Lock-order graph** — every lexically nested acquisition ``with A: …
+with B:`` adds an edge ``A -> B``; calling a sibling method while holding
+``A`` adds edges from ``A`` to every lock that method (transitively)
+acquires.  A cycle in the union graph is a potential deadlock and is
+reported as ``lock-order-cycle``.
+
+Rules emitted here: ``unguarded-write``, ``lock-order-cycle``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+from .findings import Finding
+
+_GUARDED_RE = re.compile(r"#\s*guarded_by:\s*(?P<lock>[A-Za-z_][\w.]*)")
+
+#: method names that mutate their receiver in place
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "add", "update", "pop",
+    "popleft", "popitem", "remove", "discard", "clear", "setdefault",
+}
+
+#: a with-item that looks like a lock acquisition
+_LOCKISH_RE = re.compile(r"(lock|cond|mutex|sem)", re.IGNORECASE)
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - defensive
+        return "<expr>"
+
+
+def collect_guarded_attrs(source: str) -> dict[int, str]:
+    """Map 1-based line number -> lock expression for ``# guarded_by:``
+    comments.  A comment-only annotation line also covers the next line."""
+    out: dict[int, str] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _GUARDED_RE.search(text)
+        if not m:
+            continue
+        out[i] = m.group("lock")
+        if text.lstrip().startswith("#"):
+            out[i + 1] = m.group("lock")
+    return out
+
+
+@dataclasses.dataclass
+class LockEdge:
+    src: str
+    dst: str
+    path: str
+    line: int
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Per-method facts: write sites, with-nesting, direct locks, calls."""
+
+    def __init__(self) -> None:
+        self.with_stack: list[str] = []
+        self.writes: list[tuple[str, ast.AST, tuple[str, ...]]] = []
+        self.direct_locks: set[str] = set()
+        #: (held locks at call time, sibling method name, call node)
+        self.calls: list[tuple[tuple[str, ...], str, ast.Call]] = []
+        self.nested: list[tuple[str, str, ast.AST]] = []  # (outer, inner, at)
+
+    # -- with ------------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            expr = _unparse(item.context_expr)
+            # `with self._lock:` / `with lock:` — strip `.acquire()` wrappers
+            if _LOCKISH_RE.search(expr):
+                for held in self.with_stack:
+                    self.nested.append((held, expr, item.context_expr))
+                self.direct_locks.add(expr)
+                acquired.append(expr)
+        self.with_stack.extend(acquired)
+        self.generic_visit(node)
+        del self.with_stack[len(self.with_stack) - len(acquired):]
+
+    # -- writes ----------------------------------------------------------
+    def _self_attr(self, node: ast.expr) -> str | None:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    def _record_store(self, target: ast.expr, at: ast.AST) -> None:
+        held = tuple(self.with_stack)
+        attr = self._self_attr(target)
+        if attr is not None:
+            self.writes.append((attr, at, held))
+            return
+        if isinstance(target, ast.Subscript):
+            attr = self._self_attr(target.value)
+            if attr is not None:
+                self.writes.append((attr, at, held))
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._record_store(e, at)
+        elif isinstance(target, ast.Starred):
+            self._record_store(target.value, at)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            self._record_store(tgt, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_store(node.target, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_store(node.target, node)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for tgt in node.targets:
+            self._record_store(tgt, node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            # self.attr.append(...) — in-place mutation of a guarded attr
+            attr = self._self_attr(func.value)
+            if attr is not None and func.attr in _MUTATORS:
+                self.writes.append((attr, node, tuple(self.with_stack)))
+            # self.method(...) while holding locks — call-mediated ordering
+            if self._self_attr(func) is not None and self.with_stack:
+                self.calls.append((tuple(self.with_stack), func.attr, node))
+        self.generic_visit(node)
+
+    # don't descend into nested defs with a stale with-stack: a nested
+    # function runs later, not under the current locks
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        saved, self.with_stack = self.with_stack, []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.with_stack = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+
+def _qualify(cls: str, lock: str) -> str:
+    return lock.replace("self.", f"{cls}.", 1) if lock.startswith("self.") else lock
+
+
+def check_module(path: str, tree: ast.Module, source: str) -> tuple[
+    list[Finding], list[LockEdge]
+]:
+    """Run lock-discipline analysis over one module.
+
+    Returns per-module ``unguarded-write`` findings plus the module's
+    contribution to the global lock-order graph (cycle detection runs over
+    the union of all modules' edges — see :func:`detect_cycles`).
+    """
+    annotations = collect_guarded_attrs(source)
+    findings: list[Finding] = []
+    edges: list[LockEdge] = []
+
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        methods = {
+            m.name: m for m in cls.body if isinstance(m, ast.FunctionDef)
+        }
+        # attr -> lock, discovered from annotated `self.x = ...` lines
+        guarded: dict[str, str] = {}
+        for m in methods.values():
+            for node in ast.walk(m):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    lock = annotations.get(node.lineno)
+                    if lock is None:
+                        continue
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for tgt in targets:
+                        if (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                        ):
+                            guarded[tgt.attr] = lock
+
+        scans = {name: _MethodScan() for name in methods}
+        for name, m in methods.items():
+            for stmt in m.body:
+                scans[name].visit(stmt)
+
+        # -- unguarded writes --------------------------------------------
+        for name, scan in scans.items():
+            if name == "__init__":  # not shared yet
+                continue
+            for attr, at, held in scan.writes:
+                lock = guarded.get(attr)
+                if lock is None or lock in held:
+                    continue
+                findings.append(
+                    Finding(
+                        "unguarded-write", path, at.lineno, at.col_offset,
+                        f"write to `self.{attr}` (guarded_by {lock}) outside "
+                        f"`with {lock}:`",
+                    )
+                )
+
+        # -- lock-order edges --------------------------------------------
+        for scan in scans.values():
+            for outer, inner, at in scan.nested:
+                edges.append(
+                    LockEdge(
+                        _qualify(cls.name, outer), _qualify(cls.name, inner),
+                        path, at.lineno,
+                    )
+                )
+
+        # call-mediated edges: transitive lock sets per method
+        lock_sets = {n: set(s.direct_locks) for n, s in scans.items()}
+        changed = True
+        while changed:
+            changed = False
+            for name, scan in scans.items():
+                for _, callee, _ in scan.calls:
+                    if callee in lock_sets:
+                        before = len(lock_sets[name])
+                        lock_sets[name] |= lock_sets[callee]
+                        changed = changed or len(lock_sets[name]) > before
+        for scan in scans.values():
+            for held, callee, at in scan.calls:
+                for dst in lock_sets.get(callee, ()):
+                    for src in held:
+                        if src != dst:
+                            edges.append(
+                                LockEdge(
+                                    _qualify(cls.name, src),
+                                    _qualify(cls.name, dst),
+                                    path, at.lineno,
+                                )
+                            )
+
+    return findings, edges
+
+
+def detect_cycles(edges: list[LockEdge]) -> list[Finding]:
+    """DFS cycle detection over the union lock-order graph."""
+    graph: dict[str, list[LockEdge]] = {}
+    for e in edges:
+        graph.setdefault(e.src, []).append(e)
+
+    findings: list[Finding] = []
+    seen_cycles: set[frozenset[str]] = set()
+
+    def dfs(node: str, stack: list[LockEdge], on_stack: set[str]) -> None:
+        for edge in graph.get(node, ()):
+            if edge.dst in on_stack:
+                # unwind to the start of the cycle
+                idx = next(
+                    (i for i, s in enumerate(stack) if s.src == edge.dst),
+                    None,
+                )
+                cycle = (stack[idx:] if idx is not None else []) + [edge]
+                key = frozenset(s.src for s in cycle)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    order = " -> ".join([c.src for c in cycle] + [edge.dst])
+                    findings.append(
+                        Finding(
+                            "lock-order-cycle", edge.path, edge.line,
+                            0,
+                            f"lock acquisition cycle: {order} — acquire in a "
+                            "single global order to avoid deadlock",
+                        )
+                    )
+                continue
+            if any(s.src == edge.dst for s in stack):
+                continue
+            dfs(edge.dst, stack + [edge], on_stack | {edge.dst})
+
+    for start in list(graph):
+        dfs(start, [], {start})
+    return findings

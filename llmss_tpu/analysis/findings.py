@@ -1,0 +1,104 @@
+"""Finding/suppression/baseline engine shared by every graftlint rule.
+
+A finding is suppressed by ``# lint: ignore[rule]`` on the offending line or
+on a comment-only line directly above it.  Findings that predate the gate
+live in a committed JSON baseline keyed by ``rule:path:line`` fingerprints;
+anything not in the baseline fails CI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from pathlib import Path
+
+#: ``# lint: ignore`` suppresses every rule on that line;
+#: ``# lint: ignore[rule-a, rule-b]`` suppresses only the named rules.
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*ignore(?:\[(?P<rules>[A-Za-z0-9_,\s\-]+)\])?"
+)
+
+#: Sentinel meaning "all rules suppressed on this line".
+ALL_RULES = "*"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}:{self.path}:{self.line}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+
+def collect_suppressions(source: str) -> dict[int, set[str]]:
+    """Map 1-based line number -> set of suppressed rule names.
+
+    A comment-only suppression line also covers the next line, so::
+
+        # lint: ignore[wall-clock-timer] heartbeat is cross-process
+        hb = time.time()
+
+    suppresses the finding on the assignment.
+    """
+    out: dict[int, set[str]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = m.group("rules")
+        names = (
+            {ALL_RULES}
+            if rules is None
+            else {r.strip() for r in rules.split(",") if r.strip()}
+        )
+        out.setdefault(i, set()).update(names)
+        if text.lstrip().startswith("#"):  # comment-only line covers the next
+            out.setdefault(i + 1, set()).update(names)
+    return out
+
+
+def is_suppressed(finding: Finding, suppressions: dict[int, set[str]]) -> bool:
+    names = suppressions.get(finding.line)
+    if not names:
+        return False
+    return ALL_RULES in names or finding.rule in names
+
+
+class Baseline:
+    """Committed set of accepted pre-existing findings."""
+
+    VERSION = 1
+
+    def __init__(self, fingerprints: set[str] | None = None):
+        self.fingerprints = fingerprints or set()
+
+    @classmethod
+    def load(cls, path: str | Path) -> "Baseline":
+        p = Path(path)
+        if not p.exists():
+            return cls()
+        data = json.loads(p.read_text())
+        if data.get("version") != cls.VERSION:
+            raise ValueError(
+                f"unsupported baseline version in {p}: {data.get('version')!r}"
+            )
+        return cls(set(data.get("findings", [])))
+
+    def write(self, path: str | Path, findings: list[Finding]) -> None:
+        payload = {
+            "version": self.VERSION,
+            "findings": sorted(f.fingerprint for f in findings),
+        }
+        Path(path).write_text(json.dumps(payload, indent=2) + "\n")
+
+    def __contains__(self, finding: Finding) -> bool:
+        return finding.fingerprint in self.fingerprints

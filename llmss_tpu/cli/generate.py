@@ -77,7 +77,7 @@ def main(argv=None):
     if args.speculative > 0 and not args.is_greedy:
         raise SystemExit("--speculative requires --is_greedy")
 
-    start = time.time()
+    start = time.monotonic()
 
     import jax
 
@@ -125,7 +125,7 @@ def main(argv=None):
         seed=args.seed,
     )
 
-    t0 = time.time()
+    t0 = time.monotonic()
     first_token_at = []
     if args.speculative > 0:
         out = engine.generate_speculative(
@@ -134,10 +134,10 @@ def main(argv=None):
     else:
         out = engine.generate(
             prompts, gen,
-            on_token=lambda step, toks: first_token_at.append(time.time())
+            on_token=lambda step, toks: first_token_at.append(time.monotonic())
             if step == 0 else None,
         )
-    t1 = time.time()
+    t1 = time.monotonic()
 
     n_generated = sum(len(o) for o in out)
     for i, (p, o) in enumerate(zip(prompts, out)):
@@ -150,7 +150,7 @@ def main(argv=None):
             print(f"[{i}] prompt ids: {p}")
             print(f"[{i}] continuation ids: {o}")
 
-    elapsed = time.time() - start
+    elapsed = time.monotonic() - start
     ttft_ms = (first_token_at[0] - t0) * 1000 if first_token_at else None
     ttft_s = f"ttft: {ttft_ms:.1f}ms | " if ttft_ms is not None else ""
     spec_s = ""

@@ -774,12 +774,14 @@ class DecodeEngine:
                     # this the stat would record ~µs dispatch overhead, not
                     # step latency. The loop reads the token next iteration
                     # anyway, so this costs nothing.
-                    tok.block_until_ready()
+                    tok.block_until_ready()  # lint: ignore[host-sync-in-loop]
                 tok = self.canon_vec(tok)
                 cache = self.canon_cache(cache)
                 cur_pos = cur_pos + 1
                 pos_hi += 1
-                process(np.asarray(tok))
+                # Deliberate per-step fetch: chunk_steps=1 IS the
+                # token-granularity streaming mode; the sync is the product.
+                process(np.asarray(tok))  # lint: ignore[host-sync-in-loop]
                 flush_increments()
             else:
                 t0 = time.perf_counter()
@@ -791,8 +793,11 @@ class DecodeEngine:
                 cache = self.canon_cache(cache)
                 cur_pos = self.canon_vec(cur_pos)
                 pos_hi += k
-                chunk_np = np.asarray(toks)  # [B, k] — the real host sync
-                poisoned_np = np.asarray(poisoned)
+                # One fetch per k-step chunk BY DESIGN: this single sync
+                # amortizes host-link latency over the whole chunk (the
+                # pipelined scheduler overlaps it with the next dispatch).
+                chunk_np = np.asarray(toks)  # lint: ignore[host-sync-in-loop]
+                poisoned_np = np.asarray(poisoned)  # lint: ignore[host-sync-in-loop]
                 self.metrics.decode_step.record(
                     (time.perf_counter() - t0) / k
                 )

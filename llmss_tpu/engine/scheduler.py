@@ -126,9 +126,9 @@ class ContinuousBatcher:
             else max(1, chunk_steps // 2)
         )
         self.cache = engine.new_cache(rows)
-        self.pending: deque = deque()
+        self.pending: deque = deque()  # guarded_by: self._lock
         self.active: dict[int, _Row] = {}
-        self._free = list(range(rows))
+        self._free = list(range(rows))  # guarded_by: self._lock
         # Host-side upper bound on each ACTIVE row's ring position — drives
         # the decode chunk's cache-read bucket (engine.decode_bucket): the
         # chunk reads only the live-context prefix of the ring, so decode
@@ -143,7 +143,7 @@ class ContinuousBatcher:
         self._tokens_dev = engine.canon_vec(jnp.zeros(rows, jnp.int32))
         self._cur_pos_dev = engine.canon_vec(jnp.zeros(rows, jnp.int32))
         self._step_count = 0
-        self._cancelled: set[str] = set()
+        self._cancelled: set[str] = set()  # guarded_by: self._lock
         self._inflight: _InFlightChunk | None = None
         self._pending_adm: _InFlightAdmission | None = None
         self._last_fetch_t: float | None = None

@@ -332,7 +332,10 @@ def generate_speculative(
             + [jnp.stack([e for _, e in group]).reshape(-1)]
             + [hist_len, done.astype(jnp.int32)]
         )
-        packed = np.asarray(packed_dev)
+        # Deliberate single fetch per speculative group: the packing above
+        # exists precisely so the whole group's choices/emits/state cross
+        # the host link in ONE transfer instead of per-step fetches.
+        packed = np.asarray(packed_dev)  # lint: ignore[host-sync-in-loop]
         ch_np = packed[: m * B * S].reshape(m, B, S)
         ne_np = packed[m * B * S: m * B * (S + 1)].reshape(m, B)
         hl_host = packed[m * B * (S + 1): m * B * (S + 1) + B]
@@ -377,7 +380,9 @@ def generate_speculative(
             cur = engine.canon_vec(cur)
             tok_cur = engine.canon_vec(toks[:, -1])
             pos_hi += k
-            t_np = np.asarray(toks)  # [B, k]
+            # One fetch per k-step tail chunk (same amortization as
+            # engine.generate's chunked decode loop).
+            t_np = np.asarray(toks)  # lint: ignore[host-sync-in-loop]
             for col in range(k):
                 for r in range(B):
                     if not done_np[r]:

@@ -203,19 +203,21 @@ class InProcBroker(Broker):
             response_ttl_s if response_ttl_s is not None else self.CANCEL_TTL_S
         )
         self._requests: queue.Queue[GenerateRequest] = queue.Queue()
-        self._responses: dict[str, GenerateResponse] = {}
-        self._response_expiry: dict[str, float] = {}
+        self._responses: dict[str, GenerateResponse] = {}  # guarded_by: self._cond
+        self._response_expiry: dict[str, float] = {}  # guarded_by: self._cond
         self._cond = threading.Condition()
         self._metrics: dict = {}
-        self._cancels: dict[str, float] = {}  # id -> flag deadline
+        # id -> flag deadline
+        self._cancels: dict[str, float] = {}  # guarded_by: self._cancel_lock
         self._cancel_lock = threading.Lock()
-        self._streams: dict[str, queue.Queue] = {}
-        self._dead_streams: dict[str, float] = {}  # id -> tombstone expiry
+        self._streams: dict[str, queue.Queue] = {}  # guarded_by: self._stream_lock
+        # id -> tombstone expiry
+        self._dead_streams: dict[str, float] = {}  # guarded_by: self._stream_lock
         self._stream_lock = threading.Lock()
-        self._leases: dict[str, tuple[float, GenerateRequest]] = {}
+        self._leases: dict[str, tuple[float, GenerateRequest]] = {}  # guarded_by: self._lease_lock
         self._lease_lock = threading.Lock()
-        self._dlq: list[GenerateRequest] = []
-        self._delivery_counts = {
+        self._dlq: list[GenerateRequest] = []  # guarded_by: self._lease_lock
+        self._delivery_counts = {  # guarded_by: self._lease_lock
             "redelivered": 0, "dead_lettered": 0, "deadline_expired": 0,
         }
 
@@ -455,13 +457,29 @@ class RedisBroker(Broker):
         # cannot survive forever even if no reaper ever runs again.
         return max(3600, int(self.lease_s * 20))
 
+    def _now(self) -> float:
+        """Clock for lease ``expires_at`` stamps.
+
+        Lease expiry is judged cross-process (any worker's reaper reads any
+        worker's lease), so local ``time.monotonic()`` epochs don't line up
+        and local wall clock steps under NTP. The Redis server's own TIME is
+        the one clock every participant shares, so leases are stamped and
+        reaped against it. Clients without ``time()`` (minimal fakes) fall
+        back to local monotonic, which is correct single-process.
+        """
+        server_time = getattr(self._r, "time", None)
+        if server_time is None:
+            return time.monotonic()
+        sec, usec = server_time()
+        return float(sec) + float(usec) / 1e6
+
     def _write_lease(self, req: GenerateRequest) -> None:
         import json
 
         self._r.set(
             self._lease_key(req.id),
             json.dumps({
-                "expires_at": time.time() + self.lease_s,
+                "expires_at": self._now() + self.lease_s,
                 "req": req.to_json(),
             }),
             ex=self._lease_ttl(),
@@ -476,13 +494,13 @@ class RedisBroker(Broker):
             if raw is None:
                 continue
             entry = json.loads(raw)
-            entry["expires_at"] = time.time() + self.lease_s
+            entry["expires_at"] = self._now() + self.lease_s
             self._r.set(key, json.dumps(entry), ex=self._lease_ttl())
 
     def reap_expired(self) -> int:
         import json
 
-        now = time.time()
+        now = self._now()
         n = 0
         for key in list(self._r.scan_iter(match=f"{self._lease_prefix}:*")):
             raw = self._r.get(key)

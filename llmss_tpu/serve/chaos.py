@@ -412,6 +412,17 @@ class FakeRedis:
             end = None if stop == -1 else stop + 1
             return list(lst[start:end])
 
+    # -- server -------------------------------------------------------------
+
+    def time(self):
+        """Redis TIME: the server's clock as ``(seconds, microseconds)``.
+        ``RedisBroker`` stamps lease expiry against this shared clock; the
+        fake derives it from ``time.monotonic()`` so tests are immune to
+        wall-clock steps (all participants share this one instance)."""
+        now = time.monotonic()
+        sec = int(now)
+        return (sec, int((now - sec) * 1e6))
+
     # -- keyspace -----------------------------------------------------------
 
     def scan_iter(self, match="*"):

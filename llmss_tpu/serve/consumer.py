@@ -80,9 +80,10 @@ class Worker:
         # INSIDE run_once, it is fully drained the moment the current batch
         # finishes.
         self.draining = False
-        # Wall-clock stamp of the last demonstrable worker progress (batch
+        # Monotonic stamp of the last demonstrable worker progress (batch
         # boundaries + every decode chunk via cancel_poll). The supervisor
-        # watchdog reads it from another thread; the heartbeat publishes it.
+        # watchdog compares it against time.monotonic() from another thread;
+        # the heartbeat converts it to wall clock only at publish time.
         self.last_progress_ts = 0.0
 
     def begin_drain(self) -> None:
@@ -126,7 +127,7 @@ class Worker:
     # -- serving loop -------------------------------------------------------
 
     def run_once(self) -> int:
-        self.last_progress_ts = time.time()
+        self.last_progress_ts = time.monotonic()
         if self.draining:
             return 0  # stop leasing; nothing held between batches
         batch = self._gather()
@@ -138,7 +139,6 @@ class Worker:
         # a cancel that raced ahead of its request still lands here.
         cancelled = self.broker.check_cancelled([r.id for r in batch])
         prompts, gens, ok = [], [], []
-        now = time.time()
         for req in batch:
             if req.id in cancelled:
                 self.engine.metrics.add_cancelled()
@@ -146,7 +146,7 @@ class Worker:
                     GenerateResponse(id=req.id, error="cancelled")
                 )
                 continue
-            if req.deadline_ts is not None and now > req.deadline_ts:
+            if req.deadline_ts is not None and time.time() > req.deadline_ts:
                 # Shed before prefill: the client's end-to-end deadline has
                 # passed, so decoding would be work nobody collects.
                 self.engine.metrics.add_expired()
@@ -188,7 +188,7 @@ class Worker:
             # multi-thousand-token batch reads as a hung worker. Touching
             # the leases here keeps a long decode from being mistaken for
             # a dead worker (same cadence, one decode chunk).
-            self.last_progress_ts = time.time()
+            self.last_progress_ts = time.monotonic()
             self.broker.publish_metrics(self.engine.metrics.to_dict())
             self.broker.touch_requests([r.id for r in ok])
             hits = self.broker.check_cancelled(
@@ -427,7 +427,7 @@ class ContinuousWorker:
         return len(ids)
 
     def run_once(self) -> int:
-        self.last_progress_ts = time.time()
+        self.last_progress_ts = time.monotonic()
         # Check the broker's TTL'd cancellation flags for exactly the ids
         # this batcher holds (pending, in-flight admission, active): the
         # flag persists until its request shows up, so cancel-before-submit
@@ -564,10 +564,10 @@ def main(argv=None):
             )
         # Inside the factory so supervised restarts (fresh batcher, fresh
         # jit wrappers) also come up fully compiled.
-        t0 = time.time()
+        t0 = time.monotonic()
         n = w.prewarm()
         logger.info(
-            "prewarmed %d executables in %.0fs", n, time.time() - t0
+            "prewarmed %d executables in %.0fs", n, time.monotonic() - t0
         )
         return w
 

@@ -52,7 +52,11 @@ def evaluate_worker_health(
                           "(metrics expired — worker presumed hung)",
             }, saw_supervisor
         return 200, {"status": "ok", "worker": "unsupervised"}, saw_supervisor
-    age = _time.time() - float(sup["heartbeat_ts"])
+    # heartbeat_ts is a wall-clock stamp published by *another process*
+    # (the supervisor converts its monotonic progress stamp at the edge);
+    # monotonic epochs don't line up across processes, so wall clock is
+    # the only clock both sides share.
+    age = _time.time() - float(sup["heartbeat_ts"])  # lint: ignore[wall-clock-timer]
     stale_after = float(sup.get("heartbeat_s", 5.0)) * stale_factor
     state = sup.get("state")
     body = {

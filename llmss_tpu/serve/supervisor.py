@@ -17,7 +17,7 @@ retries). Here the worker loop runs under a supervisor that:
 - runs a **watchdog thread**: heartbeats publish from the same thread as
   ``run_once``, so a decode step hung inside the device runtime would
   look alive right up until it looked dead. The watchdog watches a
-  wall-clock progress stamp from its own thread and, past
+  monotonic progress stamp from its own thread and, past
   ``step_timeout_s``, escalates the stall to this loop as a crash
   (``WatchdogTimeout`` raised into the blocked thread) — the worker
   restarts and its leases are reaped like any other death;
@@ -84,26 +84,32 @@ class Supervisor:
         # run_once that stalls past this is escalated as a crash.
         self.step_timeout_s = step_timeout_s
         self.restarts = 0
-        self.alive = False
+        # Liveness/stall state is written from two threads — the loop
+        # thread (run/crash paths) and the watchdog thread (escalation) —
+        # so it lives under its own lock.
+        self._state_lock = threading.Lock()
+        self.alive = False  # guarded_by: self._state_lock
         self.state = STATE_STARTING
-        self.watchdog_stalls = 0
+        self.watchdog_stalls = 0  # guarded_by: self._state_lock
         # Current restart delay. Instance state (not a loop local) so tests
         # and operators can observe backoff growth/reset; doubles after each
         # crash, resets to ``backoff_s`` once a worker has run for
         # ``stable_after_s``.
         self.backoff_current = backoff_s
-        self._last_error: str | None = None
-        self._start = time.time()
+        self._last_error: str | None = None  # guarded_by: self._state_lock
+        self._start = time.monotonic()
         self._drain = threading.Event()
-        self._drain_deadline: float | None = None
+        self._drain_deadline: float | None = None  # monotonic
         # Progress stamps: the supervisor stamps between iterations, the
         # worker stamps inside run_once (per decode chunk). max() of the
         # two is "the last time this worker demonstrably did anything" —
         # the watchdog's and the heartbeat's single source of truth.
-        self._progress_ts = time.time()
+        # Monotonic: a stall decision must not move when NTP steps the
+        # wall clock.
+        self._progress_ts = time.monotonic()
         self._worker = None
         self._loop_ident: int | None = None
-        self._stall_fired = False
+        self._stall_fired = False  # guarded_by: self._state_lock
         self._watchdog_stop = threading.Event()
         self._watchdog_thread: threading.Thread | None = None
         # Merged into EVERY broker publish (worker-side ones included), so
@@ -112,12 +118,20 @@ class Supervisor:
 
     # -- status --------------------------------------------------------------
 
-    def _progress_wall(self) -> float:
+    def _progress_mono(self) -> float:
+        """Latest progress stamp on the monotonic clock."""
         w = self._worker
         worker_ts = getattr(w, "last_progress_ts", 0.0) if w is not None else 0.0
         return max(self._progress_ts, worker_ts or 0.0)
 
     def _status(self) -> dict:
+        # heartbeat_ts crosses process boundaries (the producer computes
+        # `time.time() - heartbeat_ts` in another process), so it must be
+        # published on the wall clock; progress is *kept* monotonic and
+        # converted at the edge so the stall decision itself never moves
+        # under an NTP step.
+        age = time.monotonic() - self._progress_mono()
+        heartbeat_wall = time.time() - age  # lint: ignore[wall-clock-timer]
         return {
             "alive": self.alive,
             "state": self.state,
@@ -125,12 +139,12 @@ class Supervisor:
             "watchdog_stalls": self.watchdog_stalls,
             "step_timeout_s": self.step_timeout_s,
             "last_error": self._last_error,
-            "uptime_s": round(time.time() - self._start, 1),
+            "uptime_s": round(time.monotonic() - self._start, 1),
             # Progress-based, NOT publish-time: a worker-side publish from
             # a thread that isn't actually decoding (or a hung step whose
             # last publish was fresh) must still read as stale at the
             # producer once nothing has moved for 3× heartbeat_s.
-            "heartbeat_ts": round(self._progress_wall(), 3),
+            "heartbeat_ts": round(heartbeat_wall, 3),
             # Published so health consumers (producer /health) can judge
             # staleness without configuration coupling.
             "heartbeat_s": self.heartbeat_s,
@@ -169,7 +183,7 @@ class Supervisor:
         (``timeout_s``, default ``drain_timeout_s``) never-started requests
         are released back to the queue for other workers and still-active
         rows are aborted with an error — a stuck row can't pin the drain."""
-        self._drain_deadline = time.time() + (
+        self._drain_deadline = time.monotonic() + (
             timeout_s if timeout_s is not None else self.drain_timeout_s
         )
         self._drain.set()
@@ -226,16 +240,17 @@ class Supervisor:
             if not self.alive or self._stall_fired:
                 continue
             ident = self._loop_ident
-            stalled_for = time.time() - self._progress_wall()
+            stalled_for = time.monotonic() - self._progress_mono()
             if stalled_for <= self.step_timeout_s or ident is None:
                 continue
-            self._stall_fired = True
-            self.watchdog_stalls += 1
-            self.alive = False
-            self._last_error = (
-                f"watchdog: no decode progress for {stalled_for:.2f}s "
-                f"(step_timeout_s={self.step_timeout_s})"
-            )
+            with self._state_lock:
+                self._stall_fired = True
+                self.watchdog_stalls += 1
+                self.alive = False
+                self._last_error = (
+                    f"watchdog: no decode progress for {stalled_for:.2f}s "
+                    f"(step_timeout_s={self.step_timeout_s})"
+                )
             logger.error("%s — escalating as a crash", self._last_error)
             # Publish the stall immediately: the loop thread is the one
             # that's blocked, so it cannot publish its own death.
@@ -261,18 +276,19 @@ class Supervisor:
         try:
             while stop is None or not stop.is_set():
                 worker = None
-                started = time.time()
+                started = time.monotonic()
                 last_beat = 0.0
                 try:
                     # Factory inside the try: a rebuild failure is a crash
                     # too (backoff + budget apply), not a supervisor death.
                     self.state = STATE_STARTING
-                    self._progress_ts = time.time()
+                    self._progress_ts = time.monotonic()
                     worker = self.worker_factory()
                     self._worker = worker
-                    self._progress_ts = time.time()
-                    self._stall_fired = False
-                    self.alive = True
+                    self._progress_ts = time.monotonic()
+                    with self._state_lock:
+                        self._stall_fired = False
+                        self.alive = True
                     self.state = STATE_READY
                     drain_signaled = False
                     while stop is None or not stop.is_set():
@@ -283,9 +299,9 @@ class Supervisor:
                             if begin is not None:
                                 begin()
                             self._publish(worker)
-                            last_beat = time.time()
+                            last_beat = time.monotonic()
                         worker.run_once()
-                        now = self._progress_ts = time.time()
+                        now = self._progress_ts = time.monotonic()
                         if now - last_beat >= self.heartbeat_s:
                             self._publish(worker)
                             last_beat = now
@@ -305,9 +321,10 @@ class Supervisor:
                                 return
                     return  # stop was set inside the inner loop
                 except (WatchdogTimeout, Exception) as e:  # noqa: BLE001
-                    self.alive = False
+                    with self._state_lock:
+                        self.alive = False
+                        self._last_error = f"{type(e).__name__}: {e}"
                     self.restarts += 1
-                    self._last_error = f"{type(e).__name__}: {e}"
                     logger.error(
                         "worker crashed (%s), restart %d in %.1fs",
                         self._last_error, self.restarts,
@@ -354,7 +371,8 @@ class Supervisor:
             lifecycle_exit = (
                 self._drain.is_set() or sys.exc_info()[0] is not None
             )
-            self.alive = False
+            with self._state_lock:
+                self.alive = False
             self.state = STATE_DEAD
             if lifecycle_exit:
                 self._publish(self._worker)

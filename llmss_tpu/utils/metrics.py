@@ -22,10 +22,11 @@ class LatencyStat:
     def __init__(self, name: str, max_samples: int = 4096):
         self.name = name
         self.max_samples = max_samples
-        self._samples: list[float] = []
-        self._count = 0
-        self._total = 0.0
-        self.last_s: float | None = None  # most recent sample (seconds)
+        self._samples: list[float] = []  # guarded_by: self._lock
+        self._count = 0  # guarded_by: self._lock
+        self._total = 0.0  # guarded_by: self._lock
+        # most recent sample (seconds)
+        self.last_s: float | None = None  # guarded_by: self._lock
         self._lock = threading.Lock()
 
     def record(self, seconds: float) -> None:
@@ -86,13 +87,13 @@ class EngineMetrics:
         self.decode_step = LatencyStat("decode_step")
         self.prefill = LatencyStat("prefill")
         self._lock = threading.Lock()
-        self.tokens_generated = 0
-        self.requests_served = 0
-        self.errors = 0
-        self.cancelled = 0
-        self.deadline_expired = 0
-        self.poisoned = 0
-        self._start = time.time()
+        self.tokens_generated = 0  # guarded_by: self._lock
+        self.requests_served = 0  # guarded_by: self._lock
+        self.errors = 0  # guarded_by: self._lock
+        self.cancelled = 0  # guarded_by: self._lock
+        self.deadline_expired = 0  # guarded_by: self._lock
+        self.poisoned = 0  # guarded_by: self._lock
+        self._start = time.monotonic()
 
     def add_tokens(self, n: int) -> None:
         with self._lock:
@@ -123,7 +124,7 @@ class EngineMetrics:
             self.poisoned += n
 
     def to_dict(self) -> dict:
-        uptime = time.time() - self._start
+        uptime = time.monotonic() - self._start
         with self._lock:
             toks, reqs, errs, canc, exp, pois = (
                 self.tokens_generated, self.requests_served, self.errors,

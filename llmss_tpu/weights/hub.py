@@ -156,7 +156,7 @@ def download_weights(
 
     filenames = weight_hub_files(model_id, revision, extension)
     files: list[Path] = []
-    start = time.time()
+    start = time.monotonic()
     for i, fname in enumerate(filenames):
         last_err: Exception | None = None
         for attempt in range(max_retries):
@@ -176,7 +176,7 @@ def download_weights(
                 time.sleep(backoff_s)
         if last_err is not None:
             raise last_err
-        elapsed = time.time() - start
+        elapsed = time.monotonic() - start
         eta = (elapsed / (i + 1)) * (len(filenames) - (i + 1))
         logger.info(
             "%s",

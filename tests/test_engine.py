@@ -120,3 +120,22 @@ def test_ring_buffer_overflow(tiny_gptj, devices):
     gen = GenerationParams(max_new_tokens=20, is_greedy=True)
     out = eng.generate(prompts, gen)
     assert all(len(o) == 20 for o in out)
+
+
+def test_no_steady_state_recompiles(engine):
+    """CompileGuard (llmss_tpu/analysis): once warmed, a repeat of the same
+    workload must hit the jit caches — zero new compiles. This is the
+    runtime twin of graftlint's static shape rules: canon_vec/canon_cache
+    exist precisely so steady-state serving keeps one executable signature
+    per phase."""
+    from llmss_tpu.analysis import CompileGuard
+
+    prompts = [[5, 9, 23, 40], [3, 14, 15, 9, 26, 5]]
+    gen = GenerationParams(max_new_tokens=8, is_greedy=True)
+    engine.generate(prompts, gen)  # warmup: compiles are expected here
+
+    guard = CompileGuard.for_engine(engine)
+    assert guard._fns, "engine exposes no jitted callables to guard"
+    with guard.steady_state():
+        engine.generate(prompts, gen)
+        engine.generate(prompts, gen)

@@ -1,0 +1,12 @@
+#!/usr/bin/env python
+"""Thin wrapper so `./tools/lint.py llmss_tpu` works from the repo root."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from llmss_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
