@@ -69,6 +69,10 @@ _MODEL_DIMS = {
     # on a 16 GB v5e — single-chip analogue of the TP=8 config.
     "7b": dict(hidden_size=4096, n_layers=32, n_heads=32,
                intermediate_size=11008),
+    # CPU-runnable smoke scale for the paged A/B's functional half
+    # (token identity + block accounting are host-independent).
+    "tiny": dict(hidden_size=256, n_layers=2, n_heads=2,
+                 intermediate_size=512),
 }
 
 # Per-model operating point: batch and slope-method step counts (the 7B
@@ -78,6 +82,7 @@ _MODEL_DIMS = {
 _MODEL_RUN = {
     "1b2": dict(batch=16, n_slope=(64, 320)),
     "7b": dict(batch=4, n_slope=(32, 224)),
+    "tiny": dict(batch=4, n_slope=(8, 24)),
 }
 
 BATCH = int(os.environ.get("BENCH_BATCH", 0))  # 0 = per-model default
@@ -309,6 +314,177 @@ def run_model(model: str, kv_dtype: str | None = KV_DTYPE) -> dict:
     }
 
 
+def run_paged_ab(model: str) -> dict:
+    """Paged-vs-dense KV A/B (``python bench.py paged`` or BENCH_PAGED=1).
+
+    Two halves, written to ``BENCH_PAGED.json``:
+
+    1. **Per-layout decode cost** at identical batch/ring: marginal step
+       time via the slope method (the paged engine runs identity tables —
+       the dense-equivalent pool, so the delta IS the layout's indirection
+       cost), tok/s/chip, and the achieved HBM rate over the bytes each
+       step streams. The two runs must emit bit-identical tokens.
+    2. **Capacity accounting** in a serving-shaped scenario (each request
+       uses half its ring provision): the dense batcher provisions
+       rows x max_seq and caps concurrency at its row count; the paged
+       batcher gets the SAME KV byte budget as a block pool and must
+       sustain 2x the concurrent rows with the same tokens — with KV HBM
+       bytes per served token measured for both (provisioned bytes over
+       tokens actually materialized).
+    """
+    from llmss_tpu.engine import DecodeEngine, GenerationParams
+    from llmss_tpu.engine.scheduler import ContinuousBatcher
+    from llmss_tpu.models.decoder import init_params
+    from llmss_tpu.parallel import MeshPlan, make_mesh
+
+    run_cfg = _MODEL_RUN[model]
+    batch = BATCH or run_cfg["batch"]
+    n_slope = run_cfg["n_slope"]
+    bsz = int(os.environ.get("BENCH_BLOCK_SIZE", 16))
+    max_seq = int(os.environ.get("BENCH_MAX_SEQ", 0)) or (
+        PROMPT + n_slope[1]
+    )
+    max_seq = -(-max_seq // bsz) * bsz  # block-aligned ring
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshPlan(tp=n_dev))
+    cfg = flagship_cfg(model)
+    params = init_params(cfg, mesh, jax.random.key(0))
+    param_bytes = float(sum(
+        np.prod(x.shape) for x in jax.tree.leaves(params)
+    )) * 2
+    kv_el_bytes = 1 if KV_DTYPE == "int8" else 2
+    # KV bytes one row holds per token across all layers (k+v).
+    row_tok_bytes = 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * (
+        kv_el_bytes
+    )
+
+    gen = GenerationParams(max_new_tokens=DECODE, is_greedy=True)
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(0, cfg.vocab_size, PROMPT).tolist() for _ in range(batch)
+    ]
+
+    result: dict = {"config": dict(
+        model=model, batch=batch, ring=max_seq, block_size=bsz,
+        prompt=PROMPT, decode=DECODE, kv_dtype=KV_DTYPE or "bf16",
+        n_devices=n_dev, backend=jax.default_backend(),
+    )}
+    toks_ab = {}
+    for layout in ("dense", "paged"):
+        extra = (
+            dict(kv_layout="paged", block_size=bsz)
+            if layout == "paged" else {}
+        )
+        engine = DecodeEngine(
+            cfg, params, mesh, max_seq_len=max_seq, kv_dtype=KV_DTYPE,
+            **extra,
+        )
+        ids, lens = engine._pad_prompts(prompts)
+        sa = engine._sample_args(gen, batch)
+        eos = engine.canon_vec(jnp.full(batch, -1, jnp.int32))
+        toks_ab[layout] = engine.generate(prompts, gen)
+        step_ms, _ = _decode_slope_ms(
+            engine, ids, lens, sa, eos, batch, n_slope
+        )
+        n1, n2 = n_slope
+        per_step = []
+        for k, tb in chunk_schedule(engine, int(lens.max()), n2, CHUNK):
+            per_step += [tb if tb is not None else max_seq] * k
+        mean_kv = batch * row_tok_bytes * float(np.mean(per_step[n1:n2]))
+        result[layout] = {
+            "step_ms": round(step_ms, 3),
+            "tok_s_chip": round(batch / (step_ms * 1e-3) / n_dev, 1),
+            "achieved_hbm_gbps": round(
+                (param_bytes + mean_kv) / (step_ms * 1e-3) / 1e9, 2
+            ),
+        }
+        del engine
+    result["tokens_identical_engine"] = toks_ab["dense"] == toks_ab["paged"]
+
+    # -- capacity half: same KV byte budget, 2x the concurrent rows ------
+    rows_d = batch
+    mb = max_seq // bsz
+    budget_blocks = rows_d * mb  # == the dense batcher's rows_d * max_seq
+    g = min(DECODE, max_seq // 4)
+    ps = max_seq // 2 - g  # prompt + new == half the ring provision
+    short = [
+        rng.integers(0, cfg.vocab_size, ps).tolist()
+        for _ in range(2 * rows_d)
+    ]
+    gen_s = GenerationParams(max_new_tokens=g, is_greedy=True)
+
+    def serve(engine, rows):
+        bat = ContinuousBatcher(engine, rows=rows)
+        results = {}
+        for i, p in enumerate(short):
+            bat.submit(
+                p, gen_s, lambda t, i=i: results.__setitem__(i, t)
+            )
+        peak_rows = peak_blocks = 0
+        while not bat.idle:
+            bat.step()
+            peak_rows = max(peak_rows, len(bat.active))
+            if engine.kv_layout == "paged":
+                peak_blocks = max(
+                    peak_blocks, bat.allocator.blocks_in_use
+                )
+        return results, peak_rows, peak_blocks
+
+    dense_eng = DecodeEngine(
+        cfg, params, mesh, max_seq_len=max_seq, kv_dtype=KV_DTYPE,
+    )
+    paged_eng = DecodeEngine(
+        cfg, params, mesh, max_seq_len=max_seq, kv_dtype=KV_DTYPE,
+        kv_layout="paged", block_size=bsz, kv_blocks=budget_blocks,
+    )
+    out_d, rows_peak_d, _ = serve(dense_eng, rows_d)
+    out_p, rows_peak_p, blocks_peak = serve(paged_eng, 2 * rows_d)
+    served = 2 * rows_d * (ps + g)  # tokens materialized by the scenario
+    result["serving"] = {
+        "requests": 2 * rows_d,
+        "tokens_per_request": ps + g,
+        "kv_budget_bytes": budget_blocks * bsz * row_tok_bytes,
+        "concurrent_rows_dense": rows_peak_d,
+        "concurrent_rows_paged": rows_peak_p,
+        "concurrency_ratio": round(rows_peak_p / rows_peak_d, 2),
+        # dense serves the 2R requests in two R-row waves, each wave
+        # provisioning rows_d full rings; paged provisions only the
+        # blocks it actually mapped.
+        "kv_hbm_bytes_per_served_token_dense": round(
+            2 * rows_d * max_seq * row_tok_bytes / served, 1
+        ),
+        "kv_hbm_bytes_per_served_token_paged": round(
+            blocks_peak * bsz * row_tok_bytes / served, 1
+        ),
+        "tokens_identical_serving": all(
+            out_d[i] == out_p[i] for i in range(2 * rows_d)
+        ),
+    }
+    with open(
+        os.path.join(os.path.dirname(__file__), "BENCH_PAGED.json"), "w"
+    ) as f:
+        json.dump(result, f, indent=1)
+    identical = (
+        result["tokens_identical_engine"]
+        and result["serving"]["tokens_identical_serving"]
+    )
+    return {
+        "metric": "paged_vs_dense_decode",
+        "value": result["paged"]["tok_s_chip"],
+        "unit": (
+            f"tok/s/chip paged ({model}, batch={batch}, ring={max_seq}, "
+            f"bs={bsz}; dense={result['dense']['tok_s_chip']}, "
+            f"rows {rows_peak_d}->{rows_peak_p} at equal KV budget, "
+            f"identical_tokens={identical})"
+        ),
+        "vs_baseline": round(
+            result["paged"]["tok_s_chip"]
+            / max(result["dense"]["tok_s_chip"], 1e-9), 3
+        ),
+    }
+
+
 def main():
     # Default sweep: the 1b2 series (bf16 — comparable across rounds —
     # and int8 KV: half the cache bytes, scales folded into the attention
@@ -316,6 +492,13 @@ def main():
     # BASELINE.md north-star scale. BENCH_MODEL (optionally with
     # BENCH_KV_DTYPE) restricts to that single line; BENCH_KV_DTYPE alone
     # restricts to a single 1b2 line in that dtype.
+    import sys
+
+    if "paged" in sys.argv[1:] or os.environ.get("BENCH_PAGED"):
+        print(
+            json.dumps(run_paged_ab(MODEL or "1b2")), flush=True
+        )
+        return
     if MODEL:
         runs = [(MODEL, KV_DTYPE)]
     elif KV_DTYPE:

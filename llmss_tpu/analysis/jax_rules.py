@@ -419,7 +419,15 @@ class _JitBodyChecker(_TaintVisitor):
         is_none_test = isinstance(test, ast.Compare) and all(
             isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
         )
-        if not is_none_test and self.expr_tainted(test):
+        # isinstance() on a traced argument branches on PYTREE STRUCTURE
+        # (e.g. dense KVCache vs PagedKVCache NamedTuples) — resolved at
+        # trace time, never a tracer bool.
+        is_type_test = (
+            isinstance(test, ast.Call)
+            and isinstance(test.func, ast.Name)
+            and test.func.id == "isinstance"
+        )
+        if not is_none_test and not is_type_test and self.expr_tainted(test):
             self._flag(
                 node, "jit-if-on-tracer",
                 f"python `if` on traced value `{_unparse(test)}` — control "

@@ -10,7 +10,10 @@ jitted prefill step plus a jitted single-token decode step over a
 host↔device round trips beyond fetching the emitted token.
 """
 
-from llmss_tpu.engine.cache import KVCache
+from llmss_tpu.engine.cache import BlockAllocator, KVCache, PagedKVCache
 from llmss_tpu.engine.engine import DecodeEngine, GenerationParams, Prefix
 
-__all__ = ["DecodeEngine", "GenerationParams", "KVCache", "Prefix"]
+__all__ = [
+    "BlockAllocator", "DecodeEngine", "GenerationParams", "KVCache",
+    "PagedKVCache", "Prefix",
+]

@@ -18,6 +18,7 @@ the same layout the reference engineers by hand (replicated single KV head,
 
 from __future__ import annotations
 
+import threading
 from typing import NamedTuple
 
 import jax
@@ -169,3 +170,277 @@ def write_layer(
     k_cache = k_cache.at[b_idx, slots].set(k_new.astype(k_cache.dtype))
     v_cache = v_cache.at[b_idx, slots].set(v_new.astype(v_cache.dtype))
     return k_cache, v_cache
+
+
+# -- paged layout --------------------------------------------------------------
+#
+# The dense cache above reserves max_len slots of HBM per row whether the row
+# holds 30 tokens or 3000 — at serving scale the reservation, not the live
+# context, caps concurrency. The paged layout (vLLM's PagedAttention / TPU
+# "Ragged Paged Attention", PAPERS.md) breaks the per-row reservation: KV
+# lives in a GLOBAL pool of fixed-size blocks ``[L, num_blocks, block_size,
+# Hkv, D]`` and each row maps its logical slots onto pool blocks through a
+# small int32 ``block_tables [B, max_blocks]`` indirection. Rows then consume
+# HBM proportional to ceil(live_len / block_size) blocks, freed blocks return
+# to the pool the moment a row finishes, and rows sharing a prompt prefix
+# point their leading table entries at the SAME immutable blocks (refcounted;
+# copy-on-write on the first partial block — engine/scheduler.py).
+#
+# Logical addressing is IDENTICAL to the dense ring: token at absolute
+# position p occupies logical slot ``s = p % (max_blocks * block_size)`` and
+# physical location ``(block_tables[row, s // bs], s % bs)``. ``positions``
+# stays a per-LOGICAL-slot array [B, max_blocks * bs] (−1 = empty), so every
+# consumer of dense slot arithmetic — ring-wrap overflow, causal masks,
+# decode_mask_penalty — works unchanged on the gathered view, and paged
+# decoding is token-for-token equivalent to dense (tests/test_paged.py).
+
+
+#: Block-table entries >= num_blocks mean "unmapped". The sentinel is
+#: POSITIVE out-of-range: scatters drop it under mode="drop", and gathers
+#: clamp it to a valid block whose values are then masked by positions
+#: (negative would WRAP — the r3 admission-sentinel bug class).
+def table_sentinel(num_blocks: int) -> int:
+    return num_blocks
+
+
+class PagedKVCache(NamedTuple):
+    k: jax.Array  # [L, N, bs, Hkv, D] global block pool
+    v: jax.Array  # [L, N, bs, Hkv, D]
+    block_tables: jax.Array  # [B, MB] int32; >= N = unmapped sentinel
+    positions: jax.Array  # [B, MB*bs] int32 per LOGICAL slot, -1 = empty
+    # int8 pool variant: per-(layer, block, slot, head) dequant scales —
+    # same folding contract as the dense cache (ops/attention.py).
+    k_scale: jax.Array | None = None  # [L, N, bs, Hkv] f32
+    v_scale: jax.Array | None = None
+
+    @property
+    def max_len(self) -> int:
+        # Logical capacity per row — what slot arithmetic (``pos %
+        # max_len``) and capacity checks see; NOT the pool size.
+        return self.positions.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_blocks(self) -> int:
+        return self.block_tables.shape[1]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+
+def paged_cache_specs(
+    n_kv_heads: int, tp: int, *, quantized: bool = False,
+) -> PagedKVCache:
+    """PartitionSpecs for the paged pytree. The pool shards KV heads over
+    ``tp`` exactly like the dense cache; blocks are GLOBAL indices so the
+    block axis cannot shard over dp — the pool replicates across dp (the
+    documented v1 trade: dp>1 meshes pay pool HBM per replica; the paged
+    win is per-ROW HBM, which dp never sharded well under continuous
+    batching anyway). Tables/positions are tiny and replicated."""
+    head_axis = AXIS_TP if n_kv_heads % tp == 0 else None
+    kv = P(None, None, None, head_axis, None)
+    scale = P(None, None, None, head_axis) if quantized else None
+    return PagedKVCache(
+        k=kv, v=kv, block_tables=P(None, None), positions=P(None, None),
+        k_scale=scale, v_scale=scale,
+    )
+
+
+def paged_cache_specs_for(
+    mesh: Mesh, *, n_kv_heads: int, dtype,
+) -> PagedKVCache:
+    """Concrete-mesh spec selection for paged caches (the one policy shared
+    by ``init_paged_cache`` and ``DecodeEngine.canon_cache``, mirroring
+    ``cache_specs_for``)."""
+    return paged_cache_specs(
+        n_kv_heads, mesh.shape[AXIS_TP],
+        quantized=jnp.dtype(dtype) == jnp.int8,
+    )
+
+
+def init_paged_cache(
+    mesh: Mesh,
+    *,
+    n_layers: int,
+    batch: int,
+    max_len: int,
+    n_kv_heads: int,
+    head_dim: int,
+    dtype=jnp.bfloat16,
+    block_size: int = 16,
+    num_blocks: int | None = None,
+    identity_tables: bool = True,
+) -> PagedKVCache:
+    """Zeroed paged cache. ``identity_tables=True`` pre-maps row ``b`` to
+    blocks ``[b*MB, (b+1)*MB)`` — a dense-equivalent static layout for the
+    engine's own generate paths (no allocator in the loop). The scheduler
+    passes False and drives tables from its host-side ``BlockAllocator``."""
+    if max_len % block_size:
+        raise ValueError(
+            f"max_len {max_len} must be a multiple of block_size "
+            f"{block_size}"
+        )
+    mb = max_len // block_size
+    n = num_blocks if num_blocks is not None else batch * mb
+    if identity_tables and n < batch * mb:
+        raise ValueError(
+            f"identity tables need {batch * mb} blocks, pool has {n}"
+        )
+    quantized = jnp.dtype(dtype) == jnp.int8
+    specs = paged_cache_specs_for(mesh, n_kv_heads=n_kv_heads, dtype=dtype)
+    pool_shape = (n_layers, n, block_size, n_kv_heads, head_dim)
+
+    def put(spec, x):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    if identity_tables:
+        tables = jnp.arange(batch * mb, dtype=jnp.int32).reshape(batch, mb)
+    else:
+        tables = jnp.full((batch, mb), table_sentinel(n), jnp.int32)
+    return PagedKVCache(
+        k=put(specs.k, jnp.zeros(pool_shape, dtype)),
+        v=put(specs.v, jnp.zeros(pool_shape, dtype)),
+        block_tables=put(specs.block_tables, tables),
+        positions=put(
+            specs.positions, jnp.full((batch, max_len), -1, jnp.int32)
+        ),
+        k_scale=(
+            put(specs.k_scale, jnp.zeros(pool_shape[:-1], jnp.float32))
+            if quantized else None
+        ),
+        v_scale=(
+            put(specs.v_scale, jnp.zeros(pool_shape[:-1], jnp.float32))
+            if quantized else None
+        ),
+    )
+
+
+def logical_to_physical(
+    block_tables: jax.Array,  # [B, MB]
+    slots: jax.Array,  # [B, S] logical slot per new token
+    block_size: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Map logical slots through the row's block table: returns
+    ``(block [B, S], offset [B, S])``. Unmapped table entries pass the
+    sentinel through — callers scatter with mode="drop". Out-of-range
+    logical slots (>= MB*bs — the decode loop's write-suppression
+    sentinel for done rows) also map to an OOB block: the table GATHER
+    would otherwise clamp onto the row's last real block and the write
+    would land."""
+    MB = block_tables.shape[1]
+    idx = jnp.minimum(slots // block_size, MB - 1)
+    blk = jnp.take_along_axis(block_tables, idx, axis=1)
+    blk = jnp.where(
+        slots < MB * block_size, blk, jnp.int32(jnp.iinfo(jnp.int32).max)
+    )
+    return blk, slots % block_size
+
+
+def gather_block_view(
+    pool_layer: jax.Array,  # [N, bs, ...] one layer of the pool
+    block_tables: jax.Array,  # [B, MB]
+    n_blocks: int | None = None,  # read only the first n_blocks table cols
+) -> jax.Array:
+    """Materialize a row-indirected logical view ``[B, n_blocks*bs, ...]``
+    of one pool layer — the XLA gather fallback's cache operand. Sentinel
+    entries clamp to a real block; their values are garbage that the
+    position mask (−1 = empty) already excludes."""
+    bt = block_tables if n_blocks is None else block_tables[:, :n_blocks]
+    bt = jnp.minimum(bt, pool_layer.shape[0] - 1)
+    view = pool_layer[bt]  # [B, nb, bs, ...]
+    return view.reshape(
+        (view.shape[0], view.shape[1] * view.shape[2]) + view.shape[3:]
+    )
+
+
+def paged_write_stacked(
+    pool: jax.Array,  # [L, N, bs, ...] full stacked pool
+    new: jax.Array,  # [L, B, S, ...] fresh values for all layers
+    block_tables: jax.Array,  # [B, MB]
+    slots: jax.Array,  # [B, S] logical slots
+    block_size: int,
+) -> jax.Array:
+    """One batched all-layer scatter into the pool (the paged analogue of
+    the dense post-scan ``cache.k.at[:, b_idx, slots].set``). Writes
+    through unmapped table entries are dropped."""
+    blk, off = logical_to_physical(block_tables, slots, block_size)
+    return pool.at[:, blk, off].set(new.astype(pool.dtype), mode="drop")
+
+
+class BlockAllocator:
+    """Host-side free-list + refcounts for the global block pool.
+
+    Runs on the scheduler's worker thread but is read by metrics/health
+    threads, so all state is lock-guarded (graftlint ``guarded_by:``
+    discipline). Refcounts let immutable prefix blocks be SHARED by many
+    rows' tables: each row increfs on admission and decrefs on finish; a
+    block returns to the free list only at refcount zero."""
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = num_blocks
+        self._lock = threading.Lock()
+        # LIFO free list: recently freed blocks are re-issued first (their
+        # pool bytes are most likely still warm in any cache hierarchy).
+        self._free_list = list(range(num_blocks - 1, -1, -1))  # guarded_by: self._lock
+        self._refs: dict[int, int] = {}  # guarded_by: self._lock
+        self.evictions = 0  # guarded_by: self._lock
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free_list)
+
+    @property
+    def blocks_in_use(self) -> int:
+        with self._lock:
+            return self.num_blocks - len(self._free_list)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """Take ``n`` blocks (refcount 1 each), or None — never partial —
+        when the pool can't cover the request (the caller may evict idle
+        prefix blocks and retry)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        with self._lock:
+            if n > len(self._free_list):
+                return None
+            out = [self._free_list.pop() for _ in range(n)]
+            for b in out:
+                self._refs[b] = 1
+            return out
+
+    def incref(self, blocks: list[int]) -> None:
+        with self._lock:
+            for b in blocks:
+                self._refs[b] += 1
+
+    def free(self, blocks: list[int]) -> int:
+        """Drop one reference per block; blocks reaching refcount zero
+        return to the free list. Returns how many were actually released."""
+        released = 0
+        with self._lock:
+            for b in blocks:
+                r = self._refs[b] - 1
+                if r:
+                    self._refs[b] = r
+                else:
+                    del self._refs[b]
+                    self._free_list.append(b)
+                    released += 1
+        return released
+
+    def refcount(self, block: int) -> int:
+        with self._lock:
+            return self._refs.get(block, 0)
+
+    def record_evictions(self, n: int) -> None:
+        with self._lock:
+            self.evictions += n

@@ -32,7 +32,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from llmss_tpu.engine.cache import KVCache, init_cache
+from llmss_tpu.engine.cache import (
+    KVCache, PagedKVCache, init_cache, init_paged_cache,
+    paged_write_stacked,
+)
 from llmss_tpu.models.common import DecoderConfig
 from llmss_tpu.ops.sampling import sample
 
@@ -112,6 +115,9 @@ class DecodeEngine:
         batch_size: int = 1,
         max_seq_len: int | None = None,
         kv_dtype: str | None = None,
+        kv_layout: str = "dense",
+        block_size: int = 16,
+        kv_blocks: int | None = None,
     ):
         from llmss_tpu.utils.metrics import EngineMetrics
 
@@ -120,6 +126,36 @@ class DecodeEngine:
         self.mesh = mesh
         self.batch_size = batch_size
         self.max_seq_len = max_seq_len or cfg.max_position_embeddings
+        # kv_layout="paged": rows address KV through per-row block tables
+        # into a global block pool instead of owning a dense [T] ring —
+        # same logical-slot contract, so every generate/serve path works
+        # unchanged (models/decoder.py:_forward_paged, docs/paged-kv.md).
+        # ``kv_blocks`` sizes the scheduler's shared pool (None = the
+        # dense-equivalent batch*max_len/block_size); the engine's own
+        # generate paths always use identity tables over a full pool.
+        if kv_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'dense' or 'paged', got {kv_layout!r}"
+            )
+        self.kv_layout = kv_layout
+        self.block_size = block_size
+        self.kv_blocks = kv_blocks
+        if kv_layout == "paged":
+            from llmss_tpu.parallel.mesh import AXIS_SP
+
+            if self.max_seq_len % block_size:
+                raise ValueError(
+                    f"kv_layout='paged' needs max_seq_len "
+                    f"({self.max_seq_len}) divisible by block_size "
+                    f"({block_size})"
+                )
+            if mesh is not None and AXIS_SP in mesh.shape and (
+                mesh.shape[AXIS_SP] > 1
+            ):
+                raise ValueError(
+                    "kv_layout='paged' does not support sp > 1 meshes "
+                    "(the sequence axis is block-indirected per row)"
+                )
         if (
             cfg.rope_original_max_positions is not None
             and cfg.rope_freq_factors_short is not None
@@ -148,7 +184,7 @@ class DecodeEngine:
             self._cache_dtype = cfg.compute_dtype
         self.metrics = EngineMetrics()
         self._ladder = self.bucket_ladder()
-        self._canon_cache_memo: dict[int, KVCache] = {}
+        self._canon_cache_memo: dict[tuple, KVCache | PagedKVCache] = {}
 
         # mesh is partial-bound (a compile-time constant, not a traced arg):
         # it enables the shard_map'd Pallas attention path inside forward.
@@ -202,33 +238,62 @@ class DecodeEngine:
         return tok, logits[:, 0], cache
 
     @staticmethod
-    def _seed_impl(cache: KVCache, pk, pv, pks, pvs):
-        """Write a retained prefix segment into slots [0, P) of EVERY row
-        of a (fresh) cache, recording positions 0..P-1. Rows that go on to
-        serve non-prefix work are simply overwritten by their own prefill;
-        dummy admission rows ignore it entirely."""
-        P = pk.shape[1]
-        pos = cache.positions.at[:, :P].set(
-            jnp.arange(P, dtype=jnp.int32)[None, :]
-        )
+    def _seed_impl(cache, pk, pv, pks, pvs, plen):
+        """Write a retained prefix segment into logical slots [0, Pb) of
+        EVERY row of a (fresh) cache. The segment is BUCKET-padded
+        (``build_prefix`` keeps the prefill bucket's shape): only slots
+        below ``plen`` (traced, [] int32) record real positions — pad
+        slots stay -1 so attention never sees them, and this one jit
+        serves every prefix length in a bucket instead of compiling a
+        bespoke scatter per length. Rows that go on to serve non-prefix
+        work are simply overwritten by their own prefill; dummy admission
+        rows ignore it entirely."""
+        Pb = pk.shape[1]
+        rel = jnp.arange(Pb, dtype=jnp.int32)
+        pos_row = jnp.where(rel < plen, rel, -1)
+        pos = cache.positions.at[:, :Pb].set(pos_row[None, :])
+        if isinstance(cache, PagedKVCache):
+            B = cache.block_tables.shape[0]
+            slots = jnp.broadcast_to(rel, (B, Pb))
+
+            def scatter(pool, seg):
+                if pool is None:
+                    return None
+                new = jnp.broadcast_to(
+                    seg[:, None], (seg.shape[0], B) + seg.shape[1:]
+                )
+                # Sentinel table entries drop the write — the scheduler
+                # seeds through COW-masked tables whose SHARED prefix
+                # blocks are sentineled out (docs/paged-kv.md).
+                return paged_write_stacked(
+                    pool, new, cache.block_tables, slots, cache.block_size
+                )
+
+            return PagedKVCache(
+                k=scatter(cache.k, pk), v=scatter(cache.v, pv),
+                block_tables=cache.block_tables, positions=pos,
+                k_scale=scatter(cache.k_scale, pks),
+                v_scale=scatter(cache.v_scale, pvs),
+            )
         return KVCache(
-            k=cache.k.at[:, :, :P].set(pk[:, None]),
-            v=cache.v.at[:, :, :P].set(pv[:, None]),
+            k=cache.k.at[:, :, :Pb].set(pk[:, None]),
+            v=cache.v.at[:, :, :Pb].set(pv[:, None]),
             positions=pos,
             k_scale=(
-                cache.k_scale.at[:, :, :P].set(pks[:, None])
+                cache.k_scale.at[:, :, :Pb].set(pks[:, None])
                 if pks is not None else None
             ),
             v_scale=(
-                cache.v_scale.at[:, :, :P].set(pvs[:, None])
+                cache.v_scale.at[:, :, :Pb].set(pvs[:, None])
                 if pvs is not None else None
             ),
         )
 
-    def seed_cache(self, cache: KVCache, prefix: Prefix) -> KVCache:
+    def seed_cache(self, cache, prefix: Prefix):
         """Seed a fresh cache's rows with ``prefix`` (jitted, donating)."""
         return self._seed(
-            cache, prefix.k, prefix.v, prefix.k_scale, prefix.v_scale
+            cache, prefix.k, prefix.v, prefix.k_scale, prefix.v_scale,
+            jnp.asarray(prefix.length, jnp.int32),
         )
 
     def build_prefix(self, token_ids: list[int]) -> Prefix:
@@ -236,7 +301,14 @@ class DecodeEngine:
         for reuse by later requests that start with these tokens (shared
         system prompt, earlier turns of a session). int8 engines store the
         prefix quantized — the seeded bits are identical on every reuse
-        (storage bit-stability, models/decoder.py)."""
+        (storage bit-stability, models/decoder.py).
+
+        The retained segment keeps the prefill BUCKET's padded length
+        (pad slots carry no positions): construction rides the exact
+        executables ``prewarm(prefix_prefill=True)`` already compiled and
+        the seed scatter compiles once per bucket, not once per prefix
+        length — this removed a ~28 s one-time bespoke-shape compile per
+        distinct prefix length (PREFIX_BENCH.json)."""
         P = len(token_ids)
         if not 0 < P < self.max_seq_len:
             raise ValueError(
@@ -244,20 +316,43 @@ class DecodeEngine:
             )
         cache = self.new_cache(1)
         ids, lens = self._pad_prompts([list(token_ids)])
+        Pb = ids.shape[1]
         sa = self._sample_args(GenerationParams(), 1)
         _, _, cache = self._prefill(
             self.params, jnp.asarray(ids), cache, jnp.asarray(lens), sa,
         )
+        if isinstance(cache, PagedKVCache):
+            # Row 0 of a fresh engine cache has the identity table: logical
+            # slot s lives at pool[block s // bs, s % bs] — unfold the
+            # first ceil(Pb/bs) blocks back into a dense [L, Pb] segment
+            # (the Prefix stays layout-neutral; seeding re-scatters it
+            # through whatever tables the target cache carries).
+            bs = cache.block_size
+            nb = -(-Pb // bs)
+
+            def seg(pool):
+                if pool is None:
+                    return None
+                v = pool[:, :nb]
+                return v.reshape(
+                    (v.shape[0], nb * bs) + v.shape[3:]
+                )[:, :Pb]
+
+            return Prefix(
+                tokens=tuple(int(t) for t in token_ids),
+                k=seg(cache.k), v=seg(cache.v),
+                k_scale=seg(cache.k_scale), v_scale=seg(cache.v_scale),
+            )
         return Prefix(
             tokens=tuple(int(t) for t in token_ids),
-            k=cache.k[:, 0, :P],
-            v=cache.v[:, 0, :P],
+            k=cache.k[:, 0, :Pb],
+            v=cache.v[:, 0, :Pb],
             k_scale=(
-                cache.k_scale[:, 0, :P] if cache.k_scale is not None
+                cache.k_scale[:, 0, :Pb] if cache.k_scale is not None
                 else None
             ),
             v_scale=(
-                cache.v_scale[:, 0, :P] if cache.v_scale is not None
+                cache.v_scale[:, 0, :Pb] if cache.v_scale is not None
                 else None
             ),
         )
@@ -330,7 +425,16 @@ class DecodeEngine:
         def body(carry, _):
             tokens, cache, cur_pos, done, poisoned = carry
             positions = cur_pos[:, None]
-            slots = positions % cache.max_len
+            # Done rows stop WRITING KV: their slot goes positive-OOB, and
+            # every write site drops OOB indices. A dense done-row write
+            # was merely wasted bandwidth (the row owns its ring); under
+            # the paged layout a freed row's STALE device block table may
+            # point at blocks the allocator already handed to another row
+            # — or at shared prefix blocks, once its position wraps — so
+            # the write must not land at all (docs/paged-kv.md).
+            slots = jnp.where(
+                done[:, None], cache.max_len, positions % cache.max_len
+            )
             logits, cache = forward(
                 cfg, params, tokens[:, None], positions, cache, slots,
                 last_only=True, mesh=mesh, t_bucket=t_bucket,
@@ -518,7 +622,12 @@ class DecodeEngine:
         del cache
         return n
 
-    def new_cache(self, batch: int | None = None) -> KVCache:
+    def new_cache(self, batch: int | None = None):
+        if self.kv_layout == "paged":
+            # Engine-owned generate paths use the dense-equivalent identity
+            # layout (full pool, no allocator); the scheduler builds its
+            # shared-pool cache via new_paged_cache directly.
+            return self.new_paged_cache(batch)
         return init_cache(
             self.mesh,
             n_layers=self.cfg.n_layers,
@@ -527,6 +636,31 @@ class DecodeEngine:
             n_kv_heads=self.cfg.n_kv_heads,
             head_dim=self.cfg.head_dim,
             dtype=self._cache_dtype,
+        )
+
+    def new_paged_cache(
+        self, batch: int | None = None, *,
+        num_blocks: int | None = None, identity: bool = True,
+    ) -> PagedKVCache:
+        """Fresh paged cache. ``identity=True`` (engine generate paths)
+        pre-maps row b to blocks [b*MB, (b+1)*MB) over a full pool;
+        ``identity=False`` (scheduler) starts every table at the unmapped
+        sentinel and sizes the pool to ``num_blocks`` (default: the
+        engine's ``kv_blocks`` flag, else dense-equivalent)."""
+        b = batch or self.batch_size
+        if num_blocks is None and not identity:
+            num_blocks = self.kv_blocks
+        return init_paged_cache(
+            self.mesh,
+            n_layers=self.cfg.n_layers,
+            batch=b,
+            max_len=self.max_seq_len,
+            n_kv_heads=self.cfg.n_kv_heads,
+            head_dim=self.cfg.head_dim,
+            dtype=self._cache_dtype,
+            block_size=self.block_size,
+            num_blocks=num_blocks,
+            identity_tables=identity,
         )
 
     # -- canonical state shardings ------------------------------------------
@@ -542,33 +676,50 @@ class DecodeEngine:
     # steady-state input signature and prewarm compiles it exactly once
     # (asserted by tests/test_serve.py::test_prewarm_covers_all_shapes).
 
-    def _canon_cache_shardings(self, batch: int):
+    def _canon_cache_shardings(self, cache):
         # Memoized: canon_cache runs once per decoded token on the
-        # single-step generate path, and the shardings depend only on the
-        # batch size.
-        hit = self._canon_cache_memo.get(batch)
+        # single-step generate path. Dense shardings depend on the batch
+        # (dp shards rows); paged ones only on the layout (the pool is
+        # row-free) — the key carries both plus the cache type.
+        paged = isinstance(cache, PagedKVCache)
+        key = (paged, cache.block_tables.shape[0] if paged
+               else cache.k.shape[1])
+        hit = self._canon_cache_memo.get(key)
         if hit is not None:
             return hit
         from jax.sharding import NamedSharding
 
-        from llmss_tpu.engine.cache import cache_specs_for
-
-        specs = cache_specs_for(
-            self.mesh, batch=batch, max_len=self.max_seq_len,
-            n_kv_heads=self.cfg.n_kv_heads, dtype=self._cache_dtype,
+        from llmss_tpu.engine.cache import (
+            cache_specs_for, paged_cache_specs_for,
         )
-        out = KVCache(*[
-            NamedSharding(self.mesh, s) if s is not None else None
-            for s in specs
-        ])
-        self._canon_cache_memo[batch] = out
+
+        if paged:
+            specs = paged_cache_specs_for(
+                self.mesh, n_kv_heads=self.cfg.n_kv_heads,
+                dtype=self._cache_dtype,
+            )
+            out = PagedKVCache(*[
+                NamedSharding(self.mesh, s) if s is not None else None
+                for s in specs
+            ])
+        else:
+            specs = cache_specs_for(
+                self.mesh, batch=cache.k.shape[1],
+                max_len=self.max_seq_len,
+                n_kv_heads=self.cfg.n_kv_heads, dtype=self._cache_dtype,
+            )
+            out = KVCache(*[
+                NamedSharding(self.mesh, s) if s is not None else None
+                for s in specs
+            ])
+        self._canon_cache_memo[key] = out
         return out
 
-    def canon_cache(self, cache: KVCache) -> KVCache:
+    def canon_cache(self, cache):
         """Re-wrap a (possibly jit-produced) cache with the same canonical
         shardings ``new_cache`` uses — layout-identical, so no data moves."""
-        sh = self._canon_cache_shardings(cache.k.shape[1])
-        return KVCache(*[
+        sh = self._canon_cache_shardings(cache)
+        return type(cache)(*[
             jax.device_put(x, s) if x is not None else None
             for x, s in zip(cache, sh)
         ])

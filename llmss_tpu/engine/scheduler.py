@@ -46,7 +46,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from llmss_tpu.engine.cache import KVCache
+from llmss_tpu.engine.cache import (
+    BlockAllocator, KVCache, PagedKVCache, table_sentinel,
+)
 from llmss_tpu.engine.engine import DecodeEngine, GenerationParams, _bucket
 
 
@@ -125,7 +127,39 @@ class ContinuousBatcher:
             chunk_steps_low if chunk_steps_low is not None
             else max(1, chunk_steps // 2)
         )
-        self.cache = engine.new_cache(rows)
+        # Paged KV: the scheduling capacity unit becomes the block pool,
+        # not the row count — rows are admitted when free blocks cover
+        # prompt + max_new (+ shared prefix blocks ride for free), and a
+        # finished/cancelled row returns its blocks immediately. All the
+        # paged bookkeeping below is worker-thread state (like ``active``);
+        # only the BlockAllocator itself is cross-thread (metrics read it)
+        # and carries its own lock.
+        self._paged = engine.kv_layout == "paged"
+        if self._paged:
+            mb = engine.max_seq_len // engine.block_size
+            n_blocks = engine.kv_blocks or rows * mb
+            self.cache = engine.new_paged_cache(
+                rows, num_blocks=n_blocks, identity=False
+            )
+            self.allocator = BlockAllocator(n_blocks)
+            self._sentinel = table_sentinel(n_blocks)
+            self._host_tables = np.full((rows, mb), self._sentinel, np.int32)
+            self._row_owned: dict[int, list[int]] = {}
+            self._row_shared: dict[int, list[int]] = {}
+            # id(prefix) -> (prefix, full-block ids); the registry holds
+            # one allocator ref per block so an idle prefix survives until
+            # evicted to admit new work.
+            self._paged_prefixes: dict[int, tuple] = {}
+            engine.metrics.set_kv_blocks(total=n_blocks, in_use=0)
+            self._merge_positions = jax.jit(
+                lambda big, sub, rows_: big.at[rows_].set(sub, mode="drop"),
+                donate_argnums=(0,),
+            )
+            self._seed_blocks = jax.jit(
+                self._seed_blocks_impl, donate_argnums=(0,)
+            )
+        else:
+            self.cache = engine.new_cache(rows)
         self.pending: deque = deque()  # guarded_by: self._lock
         self.active: dict[int, _Row] = {}
         self._free = list(range(rows))  # guarded_by: self._lock
@@ -187,6 +221,218 @@ class ContinuousBatcher:
             ),
         )
 
+    # -- paged-KV plumbing --------------------------------------------------
+
+    @staticmethod
+    def _seed_blocks_impl(cache: PagedKVCache, pk, pv, pks, pvs, block_ids):
+        """Materialize a prefix's FULL blocks in the pool: the dense
+        ``Prefix`` segment's first ``nf*bs`` tokens, reshaped block-wise
+        and scattered at ``block_ids`` ([nf] int32). These blocks are
+        immutable from here on — rows reference them via their tables and
+        never write them (COW masks the seed's own writes elsewhere)."""
+        bs = cache.block_size
+        nf = block_ids.shape[0]
+
+        def put(pool, seg):
+            if pool is None:
+                return None
+            seg = seg[:, : nf * bs]
+            r = seg.reshape((seg.shape[0], nf, bs) + seg.shape[2:])
+            return pool.at[:, block_ids].set(r.astype(pool.dtype), mode="drop")
+
+        return cache._replace(
+            k=put(cache.k, pk), v=put(cache.v, pv),
+            k_scale=put(cache.k_scale, pks), v_scale=put(cache.v_scale, pvs),
+        )
+
+    def _dev_tables(self, tables: np.ndarray) -> jax.Array:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(
+            jnp.asarray(tables, jnp.int32),
+            NamedSharding(self.engine.mesh, PartitionSpec()),
+        )
+
+    def _paged_scratch_view(
+        self, P: int, tables: np.ndarray | None = None
+    ) -> PagedKVCache:
+        """A P-row admission 'scratch cache' that SHARES the big pool:
+        fresh per-view positions and the admitted rows' tables, but the
+        same pool buffers — prefill writes land in place, so absorbing an
+        admission is a positions merge + table upload, never a KV copy."""
+        eng = self.engine
+        if tables is None:
+            mb = eng.max_seq_len // eng.block_size
+            tables = np.full((P, mb), self._sentinel, np.int32)
+        return PagedKVCache(
+            k=self.cache.k, v=self.cache.v,
+            block_tables=self._dev_tables(tables),
+            positions=eng.canon_vec(
+                jnp.full((P, eng.max_seq_len), -1, jnp.int32)
+            ),
+            k_scale=self.cache.k_scale, v_scale=self.cache.v_scale,
+        )
+
+    def _paged_absorb(self, view: PagedKVCache, row_idx: np.ndarray) -> None:
+        """Fold a prefilled scratch view back into the big cache. The view's
+        pool buffers ARE the big cache's (threaded through the seed/prefill
+        donations), so only row positions scatter in and the host tables
+        upload — this is also where freed rows' device tables go sentinel,
+        cutting off their stale reads."""
+        eng = self.engine
+        view = eng.canon_cache(view)
+        self.cache = eng.canon_cache(PagedKVCache(
+            k=view.k, v=view.v,
+            block_tables=self._dev_tables(self._host_tables),
+            positions=self._merge_positions(
+                self.cache.positions, view.positions, jnp.asarray(row_idx)
+            ),
+            k_scale=view.k_scale, v_scale=view.v_scale,
+        ))
+
+    def _paged_evict_idle_prefixes(self, keep: int | None = None) -> int:
+        """Reclaim prefix block sets no live row references (every block
+        at the registry's own refcount of 1) — the paged admission's
+        backstop when the pool runs dry. Returns sets evicted."""
+        freed = 0
+        for key, (_pfx, blocks) in list(self._paged_prefixes.items()):
+            if key == keep or not blocks:
+                continue
+            if all(self.allocator.refcount(b) == 1 for b in blocks):
+                self.allocator.free(blocks)
+                del self._paged_prefixes[key]
+                freed += 1
+        if freed:
+            self.allocator.record_evictions(freed)
+            self.engine.metrics.add_kv_evictions(freed)
+        return freed
+
+    def _ensure_paged_prefix(self, prefix) -> list[int] | None:
+        """Register a retained Prefix's FULL blocks in the pool (once per
+        prefix object): allocate, scatter the dense segment in, and hold
+        one ref per block so the set outlives its rows. Returns the block
+        ids (possibly []), or None when the pool can't fit them even
+        after evicting idle prefixes."""
+        key = id(prefix)
+        hit = self._paged_prefixes.get(key)
+        if hit is not None:
+            return hit[1]
+        bs = self.engine.block_size
+        nf = prefix.length // bs
+        if nf == 0:
+            self._paged_prefixes[key] = (prefix, [])
+            return []
+        blocks = self.allocator.alloc(nf)
+        if blocks is None and self._paged_evict_idle_prefixes(keep=key):
+            blocks = self.allocator.alloc(nf)
+        if blocks is None:
+            return None
+        self.cache = self.engine.canon_cache(self._seed_blocks(
+            self.cache, prefix.k, prefix.v, prefix.k_scale, prefix.v_scale,
+            jnp.asarray(blocks, jnp.int32),
+        ))
+        self._paged_prefixes[key] = (prefix, blocks)
+        return blocks
+
+    def _paged_reserve(self, taken: list, rows: list[int], head_prefix):
+        """Block-pool admission control: reserve each candidate row's
+        blocks (``ceil((prompt + max_new)/bs)`` minus the prefix's shared
+        full blocks, which are increfed instead of copied — the COW
+        partial tail lands in the row's first owned block). Rows that
+        don't fit requeue to the FRONT of the queue in order and their
+        row slots go back — admission degrades to pool capacity, not row
+        count. Returns the (items, rows) that did fit."""
+        bs = self.engine.block_size
+        shared: list[int] = []
+        if head_prefix is not None:
+            got = self._ensure_paged_prefix(head_prefix)
+            if got is None:
+                with self._lock:
+                    for item, row in zip(reversed(taken), reversed(rows)):
+                        self.pending.appendleft(item)
+                        self._free.append(row)
+                return [], []
+            shared = got
+        ns = len(shared)
+        keep = id(head_prefix) if head_prefix is not None else None
+        ok_items, ok_rows, failed = [], [], []
+        for item, row in zip(taken, rows):
+            ids, gen = item[1], item[2]
+            need = -(-(len(ids) + gen.max_new_tokens) // bs) - ns
+            if need + ns > self.allocator.num_blocks:
+                # Bigger than the whole pool: requeueing would spin
+                # forever. Answer it now (check_capacity bounds requests
+                # by max_seq_len, not by a smaller kv_blocks setting).
+                with self._lock:
+                    self._free.append(row)
+                self.engine.metrics.add_error(1)
+                item[3]([], error=(
+                    f"request needs {need + ns} KV blocks but the pool "
+                    f"has {self.allocator.num_blocks}"
+                ))
+                continue
+            owned = self.allocator.alloc(need)
+            if owned is None and self._paged_evict_idle_prefixes(keep=keep):
+                owned = self.allocator.alloc(need)
+            if owned is None:
+                failed.append((item, row))
+                continue
+            if shared:
+                self.allocator.incref(shared)
+            self._row_owned[row] = owned
+            self._row_shared[row] = list(shared)
+            self._host_tables[row, :] = self._sentinel
+            self._host_tables[row, :ns] = shared
+            self._host_tables[row, ns:ns + len(owned)] = owned
+            ok_items.append(item)
+            ok_rows.append(row)
+        if failed:
+            with self._lock:
+                for item, row in reversed(failed):
+                    self.pending.appendleft(item)
+                    self._free.append(row)
+        self.engine.metrics.set_kv_blocks(
+            in_use=self.allocator.blocks_in_use
+        )
+        return ok_items, ok_rows
+
+    def _paged_release_row(self, row: int) -> None:
+        """Return a finished/cancelled row's blocks to the pool NOW (owned
+        blocks free; shared prefix blocks decref). The device-side table
+        stays stale until the next admission uploads tables — safe because
+        done rows' KV writes are slot-suppressed on device
+        (DecodeEngine._decode_many_impl) and nobody reads a freed row."""
+        if not self._paged:
+            return
+        self.allocator.free(self._row_owned.pop(row, []))
+        self.allocator.free(self._row_shared.pop(row, []))
+        self._host_tables[row, :] = self._sentinel
+        self.engine.metrics.set_kv_blocks(
+            in_use=self.allocator.blocks_in_use
+        )
+
+    def _prewarm_scratch(self, P: int):
+        """Admission scratch for prewarm. Paged: an all-sentinel VIEW over
+        the live pool (every write drops) — the pool's shape is baked into
+        the prefill executable, so prewarming against a separately sized
+        throwaway pool would compile the wrong program."""
+        if self._paged:
+            return self._paged_scratch_view(P)
+        return self.engine.new_cache(P)
+
+    def _prewarm_absorb_pools(self, scratch) -> None:
+        """Paged prewarm threads the ONE pool through every donating
+        prefill — rebind the big cache's pool leaves from the view after
+        each call so the next view (and live serving) holds live buffers."""
+        if not self._paged:
+            return
+        eng = self.engine
+        scratch = eng.canon_cache(scratch)
+        self.cache = eng.canon_cache(self.cache._replace(
+            k=scratch.k, v=scratch.v,
+            k_scale=scratch.k_scale, v_scale=scratch.v_scale,
+        ))
+
     def prewarm(
         self, seq_buckets: list[int] | None = None,
         prefix_prefill: bool = False,
@@ -215,19 +461,21 @@ class ContinuousBatcher:
             scratch = None
             tok = None
             for S in seq_buckets:
-                scratch = eng.new_cache(P)
+                scratch = self._prewarm_scratch(P)
                 ids = jnp.zeros((P, S), np.int32)
                 lens = jnp.ones(P, np.int32)
                 tok, _, scratch = self._prefill_row(
                     eng.params, ids, scratch, jnp.asarray(lens), sa,
                 )
+                self._prewarm_absorb_pools(scratch)
                 n_compiled += 1
                 if prefix_prefill:
-                    scratch = eng.new_cache(P)
+                    scratch = self._prewarm_scratch(P)
                     tok, _, scratch = self._prefill_row(
                         eng.params, ids, scratch, jnp.asarray(lens), sa,
                         jnp.zeros(P, np.int32),
                     )
+                    self._prewarm_absorb_pools(scratch)
                     n_compiled += 1
                     # build_prefix itself runs through the ENGINE's own
                     # _prefill jit at batch=1 — a separate jit object from
@@ -241,14 +489,25 @@ class ContinuousBatcher:
                     )
                     del c1
                     n_compiled += 1
-            # Insert with all-dropped indices: compiles the P-shaped
+            # Insert/absorb with all-dropped indices: compiles the P-shaped
             # scatter without touching live rows. Once — the live path
             # feeds it exactly these canonical shardings.
-            scratch = eng.canon_cache(scratch)
-            self.cache = eng.canon_cache(self._insert(
-                self.cache, scratch,
-                jnp.asarray(self._pad_row_idx(P, [])),
-            ))
+            if self._paged:
+                self.cache = eng.canon_cache(self.cache._replace(
+                    positions=self._merge_positions(
+                        self.cache.positions,
+                        eng.canon_vec(
+                            jnp.full((P, eng.max_seq_len), -1, jnp.int32)
+                        ),
+                        jnp.asarray(self._pad_row_idx(P, [])),
+                    ),
+                ))
+            else:
+                scratch = eng.canon_cache(scratch)
+                self.cache = eng.canon_cache(self._insert(
+                    self.cache, scratch,
+                    jnp.asarray(self._pad_row_idx(P, [])),
+                ))
             n_compiled += 1
             self._tokens_dev, self._cur_pos_dev = (
                 eng.canon_vec(x) for x in eng._admit_merge(
@@ -377,6 +636,15 @@ class ContinuousBatcher:
             rows = [self._free.pop() for _ in taken]
             n = len(taken)
 
+        if self._paged:
+            # Second gate: row slots are necessary but not sufficient —
+            # each row also needs blocks for prompt + max_new. Rows that
+            # don't fit the pool went back to the queue inside.
+            taken, rows = self._paged_reserve(taken, rows, head_prefix)
+            if not taken:
+                return None
+            n = len(taken)
+
         P = 1
         while P < n:
             P *= 2
@@ -398,26 +666,66 @@ class ContinuousBatcher:
         gens += [GenerationParams()] * (P - n)
         row_idx = self._pad_row_idx(P, rows)
 
-        scratch = self.engine.new_cache(P)
         sample_args = self.engine._sample_args(gens, P)
-        if head_prefix is not None:
+        if self._paged:
+            mb = self.engine.max_seq_len // self.engine.block_size
+            sub_tables = np.full((P, mb), self._sentinel, np.int32)
+            sub_tables[:n] = self._host_tables[rows]
+            scratch = self._paged_scratch_view(P, sub_tables)
+            if head_prefix is not None:
+                # Seed through COW-masked tables: the SHARED full blocks'
+                # columns are sentineled out so the seed's writes to them
+                # drop (they were materialized once by _seed_blocks); only
+                # the partial tail lands, in each row's first OWNED block —
+                # the copy-on-write copy (docs/paged-kv.md).
+                ns = len(self._row_shared[rows[0]])
+                seed_tables = sub_tables.copy()
+                seed_tables[:, :ns] = self._sentinel
+                seeded = self.engine.seed_cache(
+                    scratch._replace(
+                        block_tables=self._dev_tables(seed_tables)
+                    ),
+                    head_prefix,
+                )
+                scratch = self.engine.canon_cache(
+                    seeded._replace(block_tables=scratch.block_tables)
+                )
+                tok, _, scratch = self._prefill_row(
+                    self.engine.params, jnp.asarray(padded), scratch,
+                    jnp.asarray(lens), sample_args,
+                    jnp.full(P, plen, jnp.int32),
+                )
+            else:
+                tok, _, scratch = self._prefill_row(
+                    self.engine.params, jnp.asarray(padded), scratch,
+                    jnp.asarray(lens), sample_args,
+                )
+            # The view's pool buffers ARE the big cache's (threaded through
+            # the seed/prefill donations) — absorbing is a positions merge
+            # + host-table upload, never a KV copy.
+            self._paged_absorb(scratch, row_idx)
+        elif head_prefix is not None:
             scratch = self.engine.canon_cache(
-                self.engine.seed_cache(scratch, head_prefix)
+                self.engine.seed_cache(self.engine.new_cache(P), head_prefix)
             )
             tok, _, scratch = self._prefill_row(
                 self.engine.params, jnp.asarray(padded), scratch,
                 jnp.asarray(lens), sample_args,
                 jnp.full(P, plen, jnp.int32),
             )
+            scratch = self.engine.canon_cache(scratch)
+            self.cache = self.engine.canon_cache(self._insert(
+                self.cache, scratch, jnp.asarray(row_idx)
+            ))
         else:
             tok, _, scratch = self._prefill_row(
-                self.engine.params, jnp.asarray(padded), scratch,
-                jnp.asarray(lens), sample_args,
+                self.engine.params, jnp.asarray(padded),
+                self.engine.new_cache(P), jnp.asarray(lens), sample_args,
             )
-        scratch = self.engine.canon_cache(scratch)
-        self.cache = self.engine.canon_cache(self._insert(
-            self.cache, scratch, jnp.asarray(row_idx)
-        ))
+            scratch = self.engine.canon_cache(scratch)
+            self.cache = self.engine.canon_cache(self._insert(
+                self.cache, scratch, jnp.asarray(row_idx)
+            ))
         self._tokens_dev, self._cur_pos_dev = (
             self.engine.canon_vec(x) for x in self.engine._admit_merge(
                 self._tokens_dev, self._cur_pos_dev,
@@ -485,6 +793,7 @@ class ContinuousBatcher:
     ) -> None:
         self.active.pop(row, None)
         self._row_pos.pop(row, None)
+        self._paged_release_row(row)
         with self._lock:
             self._free.append(row)
         self._flush_stream(r)
@@ -567,6 +876,7 @@ class ContinuousBatcher:
         for row in list(self.active):
             r = self.active.pop(row)
             ids.append(r.req_id)
+            self._paged_release_row(row)
             with self._lock:
                 self._free.append(row)
         return ids

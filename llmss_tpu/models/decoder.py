@@ -31,7 +31,9 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from llmss_tpu.engine.cache import (
-    KVCache, dequantize_kv, quantize_kv, write_layer, write_positions,
+    KVCache, PagedKVCache, dequantize_kv, gather_block_view,
+    logical_to_physical, paged_write_stacked, quantize_kv, write_layer,
+    write_positions,
 )
 from llmss_tpu.models.common import DecoderConfig, act_fn
 from llmss_tpu.ops.attention import (
@@ -40,6 +42,7 @@ from llmss_tpu.ops.attention import (
     fresh_kv_decode_attention,
     fresh_kv_window_attention,
     make_causal_mask,
+    paged_decode_attention,
     window_mask_penalty,
 )
 from llmss_tpu.ops.layers import (
@@ -449,6 +452,58 @@ def _make_sp_decode_attn(cfg, mesh, cache, positions, slots):
     return attn
 
 
+def _embed_in(cfg: DecoderConfig, params: Params, input_ids, positions, mesh):
+    """Token (+learned position) embedding into the hidden stream — the
+    shared entry of the dense and paged forwards."""
+    dtype = cfg.compute_dtype
+    # Vocab-parallel embedding. Prefill uses the one-hot matmul formulation:
+    # algebraically the reference's mask + partial-gather + psum
+    # (layers.py:200-213), and it stays on the MXU. Decode (S=1) uses a
+    # gather — the one-hot matmul streams the whole [V, E] table through
+    # the MXU for one token (~5% of all param bytes per step at 1B scale),
+    # where a gather reads B·E floats.
+    one_hot = input_ids.shape[1] > 1
+    h = embedding(input_ids, params["wte"].astype(dtype), one_hot=one_hot)
+    if cfg.embed_multiplier is not None:
+        # Gemma scales hidden states by sqrt(hidden_size) post-embedding
+        # (cast-then-scale order matches HF's bf16 reference).
+        h = h * jnp.asarray(cfg.embed_multiplier, dtype)
+    if cfg.positions == "learned":
+        h = h + embedding(
+            positions, params["wpe"].astype(dtype), one_hot=one_hot
+        )
+    return constrain(h, P(AXIS_DP, _seq_axis(mesh, h.shape[1]), None))
+
+
+def _head_out(
+    cfg: DecoderConfig, params: Params, h, gather_idx, last_only,
+    _ablate=None,
+):
+    """Final norm + hidden-state gather + vocab head — the shared exit of
+    the dense and paged forwards. Returns fp32 logits."""
+    h = _norm(cfg, h, params["ln_f"])
+    if gather_idx is not None:
+        B = h.shape[0]
+        h = h[jnp.arange(B), gather_idx][:, None, :]
+    elif last_only:
+        h = h[:, -1:, :]
+
+    if _ablate == "no_head":
+        return h[..., :8].astype(jnp.float32)
+    if cfg.tie_word_embeddings:
+        # Tied head (gpt_bigcode_modeling.py:792-797): contract against the
+        # vocab-sharded embedding; constraining the output replicated makes
+        # XLA emit the reference's all-gather (layers.py:125).
+        logits = jnp.einsum(
+            "bse,ve->bsv", h, params["wte"].astype(h.dtype)
+        ).astype(jnp.float32)
+    else:
+        from llmss_tpu.ops.layers import lm_head
+
+        logits = lm_head(h, params["head"])
+    return constrain(logits, P(AXIS_DP, None, None))
+
+
 def forward(
     cfg: DecoderConfig,
     params: Params,
@@ -486,25 +541,16 @@ def forward(
     drop context. Applied only on the deferred-write decode path (S == 1,
     sp == 1, XLA attention); other paths ignore it.
     """
-    dtype = cfg.compute_dtype
-
-    # Vocab-parallel embedding. Prefill uses the one-hot matmul formulation:
-    # algebraically the reference's mask + partial-gather + psum
-    # (layers.py:200-213), and it stays on the MXU. Decode (S=1) uses a
-    # gather — the one-hot matmul streams the whole [V, E] table through
-    # the MXU for one token (~5% of all param bytes per step at 1B scale),
-    # where a gather reads B·E floats.
-    one_hot = input_ids.shape[1] > 1
-    h = embedding(input_ids, params["wte"].astype(dtype), one_hot=one_hot)
-    if cfg.embed_multiplier is not None:
-        # Gemma scales hidden states by sqrt(hidden_size) post-embedding
-        # (cast-then-scale order matches HF's bf16 reference).
-        h = h * jnp.asarray(cfg.embed_multiplier, dtype)
-    if cfg.positions == "learned":
-        h = h + embedding(
-            positions, params["wpe"].astype(dtype), one_hot=one_hot
+    if isinstance(cache, PagedKVCache):
+        return _forward_paged(
+            cfg, params, input_ids, positions, cache, slots,
+            last_only=last_only, gather_idx=gather_idx,
+            kv_write_positions=kv_write_positions, mesh=mesh,
+            t_bucket=t_bucket, _ablate=_ablate,
         )
-    h = constrain(h, P(AXIS_DP, _seq_axis(mesh, h.shape[1]), None))
+
+    dtype = cfg.compute_dtype
+    h = _embed_in(cfg, params, input_ids, positions, mesh)
 
     if kv_write_positions is None:
         kv_write_positions = positions
@@ -741,33 +787,293 @@ def forward(
                 body, h, (params["blocks"], cache.k, cache.v)
             )
 
-    h = _norm(cfg, h, params["ln_f"])
-    if gather_idx is not None:
-        B = h.shape[0]
-        h = h[jnp.arange(B), gather_idx][:, None, :]
-    elif last_only:
-        h = h[:, -1:, :]
-
-    if _ablate == "no_head":
-        logits = h[..., :8].astype(jnp.float32)
-        return logits, KVCache(
-            k=k_new, v=v_new, positions=new_kv_positions,
-            k_scale=ks_new, v_scale=vs_new,
-        )
-    if cfg.tie_word_embeddings:
-        # Tied head (gpt_bigcode_modeling.py:792-797): contract against the
-        # vocab-sharded embedding; constraining the output replicated makes
-        # XLA emit the reference's all-gather (layers.py:125).
-        logits = jnp.einsum(
-            "bse,ve->bsv", h, params["wte"].astype(h.dtype)
-        ).astype(jnp.float32)
-    else:
-        from llmss_tpu.ops.layers import lm_head
-
-        logits = lm_head(h, params["head"])
-    logits = constrain(logits, P(AXIS_DP, None, None))
-
+    logits = _head_out(cfg, params, h, gather_idx, last_only, _ablate)
     return logits, KVCache(
         k=k_new, v=v_new, positions=new_kv_positions,
         k_scale=ks_new, v_scale=vs_new,
+    )
+
+
+def _make_paged_kernel_attn(cfg, mesh, cache, positions, slots, nblk):
+    """Paged analogue of ``_make_decode_kernel_attn``: returns a
+    ``(q, k_new, v_new, k_cache, v_cache, *, layer) -> attn`` callable
+    running the ragged block-table kernel (ops/pallas_paged_decode.py), or
+    None — the XLA gather fallback (``ops.attention.paged_decode_attention``)
+    stays the implementation and the parity oracle.
+
+    Same opt-in contract as the dense kernel: only under
+    ``LLMSS_ATTN_IMPL=pallas``, with a warning fallback when shapes leave
+    the kernel envelope so A/B runs never silently measure the XLA path.
+    The pool rides replicated over dp (block indices are global — see
+    ``paged_cache_specs``) while q/fresh-KV/tables shard over dp as usual.
+    """
+    import importlib
+
+    from llmss_tpu.ops import pallas_paged_decode
+
+    attention_mod = importlib.import_module("llmss_tpu.ops.attention")
+    force = attention_mod.IMPL_OVERRIDE
+    if mesh is None or force != "pallas":
+        return None
+    dp, sp, tp = (
+        mesh.shape[AXIS_DP], mesh.shape[AXIS_SP], mesh.shape[AXIS_TP]
+    )
+    B = cache.block_tables.shape[0]
+    Hq, Hkv, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    kv_shard, heads_ok, kv_ax = attention_mod.tp_head_plan(Hq, Hkv, tp)
+    local_Hq = Hq // tp
+    local_Hkv = Hkv // tp if kv_shard else Hkv
+    if sp != 1 or B % dp or not heads_ok or not pallas_paged_decode.supports(
+        cache.block_size, local_Hq, local_Hkv, D
+    ):
+        import warnings
+
+        warnings.warn(
+            "LLMSS_ATTN_IMPL=pallas: shapes out of the paged decode kernel "
+            f"envelope (sp={sp}, B={B}, dp={dp}, bs={cache.block_size}, "
+            f"Hq={Hq}, Hkv={Hkv}, D={D}); decode runs the XLA gather path",
+            stacklevel=2,
+        )
+        return None
+    qs = P(AXIS_DP, None, AXIS_TP, None)
+    pool_s = P(None, None, None, kv_ax, None)
+    kns = P(AXIS_DP, None, kv_ax, None)
+    ps = P(AXIS_DP, None)
+    interp = jax.default_backend() != "tpu"
+
+    def local(q, kp, vp, kn, vn, qp, kvp, bt, nb, sl, layer):
+        return pallas_paged_decode.paged_decode_attention(
+            q, kp, vp, kn, vn, qp, kvp, bt, nb, sl, layer,
+            scale=cfg.attn_scale, window=cfg.sliding_window,
+            interpret=interp,
+        )
+
+    sharded = compat_shard_map(
+        local, mesh=mesh,
+        in_specs=(
+            qs, pool_s, pool_s, kns, kns, ps, ps, ps, P(AXIS_DP), ps, P()
+        ),
+        out_specs=qs, check_vma=False,
+    )
+
+    def attn(q, k_new, v_new, k_cache, v_cache, *, layer):
+        del k_cache, v_cache  # reads the stacked pool directly
+        return sharded(
+            q, cache.k, cache.v, k_new, v_new, positions, cache.positions,
+            cache.block_tables, nblk, slots, layer,
+        )
+
+    return attn
+
+
+def _forward_paged(
+    cfg: DecoderConfig,
+    params: Params,
+    input_ids: jax.Array,  # [B, S]
+    positions: jax.Array,  # [B, S]
+    cache: PagedKVCache,
+    slots: jax.Array,  # [B, S] LOGICAL slots (same arithmetic as dense)
+    *,
+    last_only: bool = False,
+    gather_idx: jax.Array | None = None,
+    kv_write_positions: jax.Array | None = None,
+    mesh=None,
+    t_bucket: int | None = None,
+    _ablate: str | None = None,
+) -> tuple[jax.Array, PagedKVCache]:
+    """``forward`` over the paged block-pool cache (``kv_layout="paged"``).
+
+    The contract with callers is IDENTICAL to the dense forward — logical
+    slots, position bookkeeping, bucketing, sampling inputs are unchanged —
+    only the storage under a row's logical slot axis is indirected through
+    its block table. Decode (S == 1) keeps the deferred-write structure:
+    attention runs over the stale pool (XLA: per-row gathered logical views,
+    identical values and slot order to the dense ring — or the ragged
+    Pallas kernel reading blocks in place), and the fresh KV lands in one
+    batched all-layer pool scatter after the scan. Prefill gathers each
+    layer's logical view, runs the dense write-then-attend block over it,
+    and persists the fresh tokens through ``(block, offset)`` scatters.
+
+    ``t_bucket`` rounds up to whole blocks (reads table columns
+    ``[0, ceil(t_bucket/bs))`` — same caller contract as dense). sp>1
+    meshes and the speculative window-defer path are dense-only for now:
+    S in (1, 8] routes through the general prefill branch here.
+    """
+    dtype = cfg.compute_dtype
+    h = _embed_in(cfg, params, input_ids, positions, mesh)
+
+    if kv_write_positions is None:
+        kv_write_positions = positions
+    new_kv_positions = write_positions(
+        cache.positions, kv_write_positions, slots
+    )
+
+    B, S = input_ids.shape
+    bs, MB = cache.block_size, cache.max_blocks
+    quant = cache.quantized
+
+    sin_cos = None
+    if cfg.positions == "rotary":
+        sin_cos = sin_cos_tables(
+            positions, cfg.rotary_dim or cfg.head_dim, cfg.rope_theta,
+            cfg.rope_freq_factors, cfg.rope_attn_factor,
+        )
+
+    if S == 1:
+        # Bucketed pool read: round the slot bucket up to whole table
+        # columns — the gather then copies only ceil(t_bucket/bs) blocks
+        # per row, so KV-read HBM traffic scales with live context exactly
+        # as the dense bucketed dynamic-slice does.
+        nb = None
+        if t_bucket is not None and t_bucket < cache.max_len:
+            nb = min(-(-t_bucket // bs), MB)
+        Tv = (nb if nb is not None else MB) * bs
+        kv_pos_src = cache.positions[:, :Tv]
+
+        kernel_attn = None
+        if not quant and _ablate is None:
+            occ = jnp.sum(
+                (cache.positions >= 0).astype(jnp.int32), axis=1
+            )
+            nblk = jnp.clip(-(-occ // bs), 0, MB).astype(jnp.int32)
+            kernel_attn = _make_paged_kernel_attn(
+                cfg, mesh, cache, positions, slots, nblk
+            )
+
+        if kernel_attn is not None:
+            def body(h, xs):
+                bp, layer = xs
+                h, k_f, v_f = _block(
+                    cfg, bp, h, positions, None, None, kv_pos_src, slots,
+                    None, mesh=mesh, defer_write=True,
+                    attn_override=partial(kernel_attn, layer=layer),
+                    sin_cos=sin_cos,
+                )
+                return h, (k_f, v_f)
+
+            h, ys = jax.lax.scan(
+                body, h,
+                (params["blocks"],
+                 jnp.arange(cfg.n_layers, dtype=jnp.int32)),
+            )
+        else:
+            penalty = decode_mask_penalty(
+                positions, kv_pos_src, slots, cfg.sliding_window
+            )
+
+            def body(h, xs):
+                if quant:
+                    bp, kp_l, vp_l, ksp_l, vsp_l = xs
+                else:
+                    bp, kp_l, vp_l = xs
+                    ksp_l = vsp_l = None
+
+                def paged_attn(q, k_new, v_new, k_c, v_c):
+                    del k_c, v_c  # reads the per-layer pool slice
+                    return paged_decode_attention(
+                        q, kp_l, vp_l, k_new, v_new, positions,
+                        kv_pos_src, cache.block_tables, slots,
+                        scale=cfg.attn_scale, window=cfg.sliding_window,
+                        penalty=penalty, k_scale_layer=ksp_l,
+                        v_scale_layer=vsp_l, n_blocks=nb,
+                    )
+
+                h, k_f, v_f = _block(
+                    cfg, bp, h, positions, None, None, kv_pos_src, slots,
+                    None, mesh=mesh, defer_write=True,
+                    attn_override=paged_attn, ablate=_ablate,
+                    sin_cos=sin_cos,
+                )
+                ys = None if _ablate == "no_scatter" else (k_f, v_f)
+                return h, ys
+
+            if quant:
+                xs = (params["blocks"], cache.k, cache.v, cache.k_scale,
+                      cache.v_scale)
+            else:
+                xs = (params["blocks"], cache.k, cache.v)
+            h, ys = jax.lax.scan(body, h, xs)
+
+        ks_new, vs_new = cache.k_scale, cache.v_scale
+        if _ablate == "no_scatter":
+            k_new, v_new = cache.k, cache.v
+        else:
+            k_fresh, v_fresh = ys  # [L, B, 1, Hkv, D]
+            if quant:
+                k_fresh, ks_f = quantize_kv(k_fresh)
+                v_fresh, vs_f = quantize_kv(v_fresh)
+                ks_new = paged_write_stacked(
+                    cache.k_scale, ks_f, cache.block_tables, slots, bs
+                )
+                vs_new = paged_write_stacked(
+                    cache.v_scale, vs_f, cache.block_tables, slots, bs
+                )
+            k_new = paged_write_stacked(
+                cache.k, k_fresh, cache.block_tables, slots, bs
+            )
+            v_new = paged_write_stacked(
+                cache.v, v_fresh, cache.block_tables, slots, bs
+            )
+    else:
+        kv_valid = new_kv_positions >= 0
+        mask = make_causal_mask(positions, new_kv_positions, kv_valid)
+        blk, off = logical_to_physical(cache.block_tables, slots, bs)
+
+        def body(h, xs):
+            # Write-then-attend over the row-indirected logical view (same
+            # values/slot order as a dense ring, so _block is reused
+            # verbatim); then persist ONLY the fresh tokens back to the
+            # pool — writes through sentinel table entries drop.
+            if quant:
+                bp, kp_l, vp_l, ksp_l, vsp_l = xs
+                k_l = dequantize_kv(
+                    gather_block_view(kp_l, cache.block_tables),
+                    gather_block_view(ksp_l, cache.block_tables), dtype,
+                )
+                v_l = dequantize_kv(
+                    gather_block_view(vp_l, cache.block_tables),
+                    gather_block_view(vsp_l, cache.block_tables), dtype,
+                )
+            else:
+                bp, kp_l, vp_l = xs
+                k_l = gather_block_view(kp_l, cache.block_tables)
+                v_l = gather_block_view(vp_l, cache.block_tables)
+            h, _, _, k_f, v_f = _block(
+                cfg, bp, h, positions, k_l, v_l, new_kv_positions, slots,
+                mask, mesh=mesh, sin_cos=sin_cos,
+            )
+            if quant:
+                # Quantize only the fresh tokens (storage bit-stability —
+                # same contract as the dense prefill branch).
+                k8, ks_f = quantize_kv(k_f)
+                v8, vs_f = quantize_kv(v_f)
+                kp_l = kp_l.at[blk, off].set(k8, mode="drop")
+                vp_l = vp_l.at[blk, off].set(v8, mode="drop")
+                ksp_l = ksp_l.at[blk, off].set(ks_f, mode="drop")
+                vsp_l = vsp_l.at[blk, off].set(vs_f, mode="drop")
+                return h, (kp_l, vp_l, ksp_l, vsp_l)
+            kp_l = kp_l.at[blk, off].set(
+                k_f.astype(kp_l.dtype), mode="drop"
+            )
+            vp_l = vp_l.at[blk, off].set(
+                v_f.astype(vp_l.dtype), mode="drop"
+            )
+            return h, (kp_l, vp_l)
+
+        if quant:
+            h, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+                body, h,
+                (params["blocks"], cache.k, cache.v, cache.k_scale,
+                 cache.v_scale),
+            )
+        else:
+            ks_new, vs_new = None, None
+            h, (k_new, v_new) = jax.lax.scan(
+                body, h, (params["blocks"], cache.k, cache.v)
+            )
+
+    logits = _head_out(cfg, params, h, gather_idx, last_only, _ablate)
+    return logits, PagedKVCache(
+        k=k_new, v=v_new, block_tables=cache.block_tables,
+        positions=new_kv_positions, k_scale=ks_new, v_scale=vs_new,
     )

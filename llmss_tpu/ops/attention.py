@@ -323,6 +323,45 @@ def fresh_kv_decode_attention(
     )
 
 
+def paged_decode_attention(
+    q: jax.Array,  # [B, 1, Hq, D]
+    k_pool_layer: jax.Array,  # [N, bs, Hkv, D] — one layer of the block pool
+    v_pool_layer: jax.Array,
+    k_new: jax.Array,  # [B, 1, Hkv, D]
+    v_new: jax.Array,
+    q_pos: jax.Array,  # [B, 1]
+    kv_pos_old: jax.Array,  # [B, nb*bs] — pre-write LOGICAL slot positions
+    block_tables: jax.Array,  # [B, MB] int32 (sentinel >= N = unmapped)
+    slots: jax.Array,  # [B, 1] — logical slot the token will occupy
+    *,
+    scale: float | None = None,
+    window: int | None = None,
+    penalty: jax.Array | None = None,  # [B, nb*bs] f32 — precomputed mask
+    k_scale_layer: jax.Array | None = None,  # [N, bs, Hkv] f32 iff int8
+    v_scale_layer: jax.Array | None = None,
+    n_blocks: int | None = None,  # bucketed read: first n_blocks table cols
+) -> jax.Array:
+    """Paged decode attention, XLA gather fallback: materialize the
+    row-indirected logical view of one pool layer (``gather_block_view``)
+    and run the exact fresh-KV merged softmax over it. The view has
+    IDENTICAL values and slot order to the dense ring a row would hold, so
+    this is token-for-token the dense decode path — the parity oracle the
+    Pallas paged kernel (ops/pallas_paged_decode.py) is tested against,
+    and the implementation ``LLMSS_ATTN_IMPL`` A/B tests compare with."""
+    from llmss_tpu.engine.cache import gather_block_view
+
+    k_view = gather_block_view(k_pool_layer, block_tables, n_blocks)
+    v_view = gather_block_view(v_pool_layer, block_tables, n_blocks)
+    ks = vs = None
+    if k_scale_layer is not None:
+        ks = gather_block_view(k_scale_layer, block_tables, n_blocks)
+        vs = gather_block_view(v_scale_layer, block_tables, n_blocks)
+    return fresh_kv_decode_attention(
+        q, k_view, v_view, k_new, v_new, q_pos, kv_pos_old, slots,
+        scale=scale, window=window, penalty=penalty, k_scale=ks, v_scale=vs,
+    )
+
+
 def dispatch_attention(
     q: jax.Array,  # [B, S, Hq, D]
     k: jax.Array,  # [B, T, Hkv, D]

@@ -1,36 +1,29 @@
-"""Pallas TPU decode attention over the layer-stacked KV cache.
+"""Pallas TPU decode attention over the paged block-pool KV cache.
 
-Why this kernel exists: the decode step scans blocks over layer-stacked
-parameters and cache. XLA aliases the *weight* slices into their dots, but
-it materializes each layer's KV slice — a ``dynamic_slice`` copying the
-full ``[B, T, Hkv, D]`` layer (33 MB at bench scale) every layer every
-step, measured at ~0.5 ms of the ~4.3 ms step (PROFILE.md). This kernel
-takes the whole stacked cache ``[L, B, T, Hkv, D]`` plus the layer index as
-a **scalar-prefetch** argument, so the block DMAs read the layer's KV
-directly from the stacked buffer in HBM — the copy disappears.
+The XLA paged decode path (``ops.attention.paged_decode_attention``) first
+GATHERS each row's blocks into a contiguous logical view — a materialized
+``[B, T, Hkv, D]`` copy of the live context every layer every step. This
+kernel reads the pool ``[L, num_blocks, bs, Hkv, D]`` directly: the grid
+walks ``(row, table_column)`` and each step's block index map resolves
+``block_tables[row, col]`` from **scalar-prefetch** SMEM, so the block DMA
+pulls exactly the row's own blocks from wherever they sit in the pool — the
+gather copy disappears, and HBM traffic is the live context ("Ragged Paged
+Attention", PAPERS.md).
 
-Semantics are identical to ``ops.attention.fresh_kv_decode_attention``
-(the XLA path, kept as the CPU/fallback implementation and the parity
-oracle in tests):
+Raggedness: rows own different numbers of blocks. ``n_blocks[b]`` (scalar
+prefetch) marks row ``b``'s occupied prefix of the table; columns past it
+clamp their index map to the row's last occupied block — Mosaic elides the
+repeated DMA — and the body skips compute for them. Unmapped/sentinel table
+entries are pre-clamped host-side to a valid block; their values are garbage
+the position mask (−1 = empty) already rejects.
 
-- attention over the *stale* cache (current token not yet written), with
-  the fresh current-token KV merged into the same online softmax;
-- the slot the current token will occupy is masked out of the cache read
-  (on ring wrap this drops the token being overwritten, matching
-  write-then-attend order);
-- position-arithmetic masking (causal, -1 = empty slot, optional sliding
-  window — the reference's KV trim, ``generate.py:132-142``, as slot
-  arithmetic);
-- fp32 softmax island (``gptj_modeling.py:140-143``): scores and m/l/acc
-  state fp32; the P·V matmul runs in value dtype with fp32 accumulation.
-
-Blocking: the Mosaic lowering requires a block's last two dims to tile the
-array's last two dims, so per-head KV blocks of ``[L, B, T, Hkv, D]`` are
-not expressible — instead each block carries **all heads** of a sequence
-chunk (``(1, 1, bk, Hkv, D)``, a contiguous DMA) and the per-kv-head dots
-batch over the head dim inside the kernel. Grid ``(B, T/bk)`` with the KV
-axis innermost/sequential so VMEM accumulators carry across chunks; the
-fresh-KV term merges in the last chunk's epilogue.
+Semantics are identical to ``paged_decode_attention`` (the CPU/fallback
+implementation and the parity oracle in tests/test_paged.py): stale-view
+attention merged with the fresh current-token KV in one online softmax, the
+pending logical slot masked out, position-arithmetic causal/window masking,
+fp32 softmax island. Layout/blocking constraints follow pallas_decode.py:
+a block carries all heads of one pool block (``(1, 1, bs, Hkv, D)``, a
+contiguous DMA) and per-kv-head dots run as plain 2D ``dot_general``s.
 """
 
 from __future__ import annotations
@@ -51,13 +44,15 @@ _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
 def _kernel(
-    layer_ref,  # [1] int32 scalar-prefetch — layer of the stacked cache
+    layer_ref,  # [1] int32 scalar-prefetch — layer of the stacked pool
     qp_ref,  # [B] int32 scalar-prefetch — query's absolute position per row
-    slot_ref,  # [B] int32 scalar-prefetch — ring slot the token will take
-    kvp_ref,  # [1, 1, bk] int32 — absolute position per KV slot (-1 empty)
+    slot_ref,  # [B] int32 scalar-prefetch — LOGICAL slot the token takes
+    nblk_ref,  # [B] int32 scalar-prefetch — occupied blocks per row
+    bt_ref,  # [B*MB] int32 scalar-prefetch — flattened clamped block table
+    kvp_ref,  # [1, 1, bs] int32 — positions of this logical block's slots
     q_ref,  # [1, Hq, D]
-    k_ref,  # [1, 1, bk, Hkv, D] — chunk of the stacked cache, all heads
-    v_ref,  # [1, 1, bk, Hkv, D]
+    k_ref,  # [1, 1, bs, Hkv, D] — one pool block, all heads
+    v_ref,  # [1, 1, bs, Hkv, D]
     kn_ref,  # [1, Hkv, D] — fresh current-token K
     vn_ref,  # [1, Hkv, D]
     o_ref,  # [1, Hq, D]
@@ -67,10 +62,10 @@ def _kernel(
     *,
     scale: float,
     window: int | None,
-    block_k: int,
+    block_size: int,
     n_kv_heads: int,
 ):
-    del layer_ref  # consumed by the index_maps, not the body
+    del layer_ref, bt_ref  # consumed by the index_maps, not the body
     b = pl.program_id(0)
     j = pl.program_id(1)
     n_j = pl.num_programs(1)
@@ -82,10 +77,10 @@ def _kernel(
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     qp = qp_ref[b]  # scalar
-    slot = slot_ref[b]  # scalar
-    kvp = kvp_ref[0, 0, :]  # [bk]
-    slot_idx = j * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (1, block_k), 1
+    slot = slot_ref[b]  # scalar (logical)
+    kvp = kvp_ref[0, 0, :]  # [bs]
+    slot_idx = j * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_size), 1
     )[0]
 
     mask = (kvp <= qp) & (kvp >= 0) & (slot_idx != slot)
@@ -96,20 +91,21 @@ def _kernel(
     Hkv = n_kv_heads
     G = Hq // Hkv
 
-    @pl.when(jnp.any(mask))
+    # Ragged skip: columns past the row's occupied prefix re-read the last
+    # occupied block (index-map clamp) — never accumulate them twice.
+    @pl.when((j < nblk_ref[b]) & jnp.any(mask))
     def _accumulate():
         # Static loop over kv heads (Mosaic's dot_general needs plain 2D
-        # operands; a batched form with the head dim mid-operand is not
-        # lowerable). Each head's flash state lives in its own scratch row
-        # range [h*G, (h+1)*G).
+        # operands); each head's flash state lives in scratch rows
+        # [h*G, (h+1)*G) — same scheme as pallas_decode.py.
         for h in range(Hkv):
             qh = q_ref[0, h * G:(h + 1) * G, :]  # [G, D]
-            kh = k_ref[0, 0, :, h, :]  # [bk, D]
+            kh = k_ref[0, 0, :, h, :]  # [bs, D]
             vh = v_ref[0, 0, :, h, :]
             s = jax.lax.dot_general(
                 qh, kh, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            ) * scale  # [G, bk] f32
+            ) * scale  # [G, bs] f32
             s = jnp.where(mask[None, :], s, _NEG_INF)
 
             r = slice(h * G, (h + 1) * G)
@@ -117,7 +113,7 @@ def _kernel(
             l_prev = l_ref[r, :1]
             m_cur = jnp.max(s, axis=1, keepdims=True)
             m_next = jnp.maximum(m_prev, m_cur)
-            p = jnp.exp(s - m_next)  # [G, bk] f32
+            p = jnp.exp(s - m_next)  # [G, bs] f32
             alpha = jnp.exp(m_prev - m_next)  # [G, 1]
             l_ref[r, :1] = alpha * l_prev + jnp.sum(
                 p, axis=1, keepdims=True
@@ -131,7 +127,7 @@ def _kernel(
     @pl.when(j == n_j - 1)
     def _merge_fresh_and_finalize():
         # The fresh token always attends itself (finite logit), so an empty
-        # cache degenerates cleanly to out = v_new — no l == 0 guard needed.
+        # row degenerates cleanly to out = v_new — no l == 0 guard needed.
         for h in range(Hkv):
             r = slice(h * G, (h + 1) * G)
             qh = q_ref[0, r, :]  # [G, D]
@@ -150,87 +146,84 @@ def _kernel(
             o_ref[0, r, :] = (acc / l).astype(o_ref.dtype)
 
 
-def _pick_block_k(T: int, block_k: int = 512) -> int | None:
-    """Largest legal KV chunk: divides T and is lane-aligned (%128) unless
-    it covers T outright."""
-    if T <= block_k:
-        return T
-    bk = block_k
-    while bk >= 128:
-        if T % bk == 0 and bk % 128 == 0:
-            return bk
-        bk //= 2
-    return None
-
-
-def supports(T: int, Hq: int, Hkv: int, D: int) -> bool:
+def supports(block_size: int, Hq: int, Hkv: int, D: int) -> bool:
     """Shape envelope the kernel handles (else the caller stays on the XLA
-    ``fresh_kv_decode_attention`` path)."""
-    return (
-        Hq % Hkv == 0
-        and T % 8 == 0
-        and D % 128 == 0
-        and _pick_block_k(T) is not None
-    )
+    gather path). Per-block DMAs need sublane-aligned block_size and a
+    lane-aligned head dim."""
+    return Hq % Hkv == 0 and block_size % 8 == 0 and D % 128 == 0
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "window", "block_k", "interpret"),
+    static_argnames=("scale", "window", "interpret"),
 )
-def decode_attention(
+def paged_decode_attention(
     q: jax.Array,  # [B, 1, Hq, D]
-    k_cache: jax.Array,  # [L, B, T, Hkv, D] — stale stacked cache
-    v_cache: jax.Array,
+    k_pool: jax.Array,  # [L, N, bs, Hkv, D] — stale stacked block pool
+    v_pool: jax.Array,
     k_new: jax.Array,  # [B, 1, Hkv, D]
     v_new: jax.Array,
     q_pos: jax.Array,  # [B, 1]
-    kv_pos: jax.Array,  # [B, T] — pre-write slot positions
-    slots: jax.Array,  # [B, 1] — slot the current token will occupy
-    layer: jax.Array,  # int32 scalar or [1] — layer to read
+    kv_pos: jax.Array,  # [B, MB*bs] — pre-write LOGICAL slot positions
+    block_tables: jax.Array,  # [B, MB] int32, pre-clamped OR sentinel
+    n_blocks: jax.Array,  # [B] int32 — occupied table prefix per row
+    slots: jax.Array,  # [B, 1] — logical slot the current token will take
+    layer: jax.Array,  # int32 scalar or [1] — pool layer to read
     *,
     scale: float | None = None,
     window: int | None = None,
-    block_k: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
-    """Single-token decode attention reading one layer of the stacked cache.
+    """Single-token ragged decode attention over one layer of the pool.
 
     Returns [B, 1, Hq, D] in q's dtype. Same contract as
-    ``fresh_kv_decode_attention`` with (k_cache[layer], v_cache[layer]).
+    ``ops.attention.paged_decode_attention`` on (k_pool[layer], ...).
     """
     B, S, Hq, D = q.shape
-    assert S == 1, "decode kernel is single-token"
-    L, _, T, Hkv, _ = k_cache.shape
+    assert S == 1, "paged decode kernel is single-token"
+    L, N, bs, Hkv, _ = k_pool.shape
+    MB = block_tables.shape[1]
     if scale is None:
         scale = 1.0 / (D**0.5)
-    bk = _pick_block_k(T, block_k)
-    assert bk is not None, f"unsupported T={T} (see supports())"
 
-    grid = (B, T // bk)
+    grid = (B, MB)
+    bt_flat = jnp.minimum(block_tables, N - 1).astype(jnp.int32).reshape(-1)
+    nblk = jnp.clip(n_blocks.astype(jnp.int32), 0, MB)
+
+    def _col(j, nb, b):
+        # Clamp ragged columns onto the row's last occupied block so the
+        # repeated DMA is elided; max() guards empty rows (nb == 0).
+        return jnp.maximum(jnp.minimum(j, nb[b] - 1), 0)
 
     out = pl.pallas_call(
         functools.partial(
-            _kernel, scale=float(scale), window=window, block_k=bk,
+            _kernel, scale=float(scale), window=window, block_size=bs,
             n_kv_heads=Hkv,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,
+            num_scalar_prefetch=5,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, 1, bk), lambda b, j, *_: (b, 0, j)),
+                pl.BlockSpec(
+                    (1, 1, bs),
+                    lambda b, j, lr, qp, sl, nb, bt: (b, _col(j, nb, b), 0),
+                ),
                 pl.BlockSpec(
                     (1, Hq, D), lambda b, j, *_: (b, 0, 0),
                     memory_space=pltpu.VMEM,
                 ),
                 pl.BlockSpec(
-                    (1, 1, bk, Hkv, D),
-                    lambda b, j, lr, qp, sl: (lr[0], b, j, 0, 0),
+                    (1, 1, bs, Hkv, D),
+                    lambda b, j, lr, qp, sl, nb, bt: (
+                        lr[0], bt[b * MB + _col(j, nb, b)], 0, 0, 0
+                    ),
                     memory_space=pltpu.VMEM,
                 ),
                 pl.BlockSpec(
-                    (1, 1, bk, Hkv, D),
-                    lambda b, j, lr, qp, sl: (lr[0], b, j, 0, 0),
+                    (1, 1, bs, Hkv, D),
+                    lambda b, j, lr, qp, sl, nb, bt: (
+                        lr[0], bt[b * MB + _col(j, nb, b)], 0, 0, 0
+                    ),
                     memory_space=pltpu.VMEM,
                 ),
                 pl.BlockSpec(
@@ -261,9 +254,11 @@ def decode_attention(
         jnp.asarray(layer, jnp.int32).reshape(1),
         q_pos.astype(jnp.int32).reshape(B),
         slots.astype(jnp.int32).reshape(B),
-        kv_pos.astype(jnp.int32)[:, None, :],
+        nblk,
+        bt_flat,
+        kv_pos.astype(jnp.int32).reshape(B, MB, bs),
         q.reshape(B, Hq, D),
-        k_cache, v_cache,
+        k_pool, v_pool,
         k_new.reshape(B, Hkv, D),
         v_new.reshape(B, Hkv, D),
     )

@@ -93,6 +93,11 @@ class EngineMetrics:
         self.cancelled = 0  # guarded_by: self._lock
         self.deadline_expired = 0  # guarded_by: self._lock
         self.poisoned = 0  # guarded_by: self._lock
+        # Paged-KV block-pool gauges (kv_layout="paged"): pool capacity,
+        # live blocks, and idle-prefix evictions. Zero on dense engines.
+        self.kv_blocks_total = 0  # guarded_by: self._lock
+        self.kv_blocks_in_use = 0  # guarded_by: self._lock
+        self.kv_block_evictions = 0  # guarded_by: self._lock
         self._start = time.monotonic()
 
     def add_tokens(self, n: int) -> None:
@@ -123,12 +128,31 @@ class EngineMetrics:
         with self._lock:
             self.poisoned += n
 
+    def set_kv_blocks(
+        self, total: int | None = None, in_use: int | None = None,
+    ) -> None:
+        """Gauge updates from the scheduler's BlockAllocator (paged KV)."""
+        with self._lock:
+            if total is not None:
+                self.kv_blocks_total = total
+            if in_use is not None:
+                self.kv_blocks_in_use = in_use
+
+    def add_kv_evictions(self, n: int = 1) -> None:
+        """Idle shared-prefix block sets reclaimed to admit new work."""
+        with self._lock:
+            self.kv_block_evictions += n
+
     def to_dict(self) -> dict:
         uptime = time.monotonic() - self._start
         with self._lock:
             toks, reqs, errs, canc, exp, pois = (
                 self.tokens_generated, self.requests_served, self.errors,
                 self.cancelled, self.deadline_expired, self.poisoned,
+            )
+            kv_total, kv_used, kv_evic = (
+                self.kv_blocks_total, self.kv_blocks_in_use,
+                self.kv_block_evictions,
             )
         return {
             "uptime_s": round(uptime, 1),
@@ -138,6 +162,9 @@ class EngineMetrics:
             "cancelled": canc,
             "deadline_expired": exp,
             "poisoned_rows": pois,
+            "kv_blocks_total": kv_total,
+            "kv_blocks_in_use": kv_used,
+            "kv_block_evictions": kv_evic,
             "tokens_per_sec_lifetime": round(toks / uptime, 2) if uptime else 0,
             "ttft": self.ttft.to_dict(),
             "prefill": self.prefill.to_dict(),

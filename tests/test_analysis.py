@@ -179,6 +179,25 @@ def attend(q, k, scale=None):
     assert (code, findings) == (0, [])
 
 
+def test_isinstance_pytree_branch_not_flagged(tmp_path):
+    # isinstance() on a jit argument branches on PYTREE STRUCTURE, which
+    # is resolved at trace time — the forward()'s dense/paged dispatch
+    # relies on this staying legal.
+    code, findings = lint(tmp_path, """
+import jax
+
+class PagedKVCache(tuple):
+    pass
+
+@jax.jit
+def forward(tok, cache):
+    if isinstance(cache, PagedKVCache):
+        tok = tok + 1
+    return tok, cache
+""")
+    assert (code, findings) == (0, [])
+
+
 def test_partial_bound_args_are_not_tracers(tmp_path):
     # partial-bound leading args (cfg, mesh) are trace-time constants:
     # branching on them is legal and must not be flagged.
