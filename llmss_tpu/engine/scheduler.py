@@ -857,6 +857,36 @@ class ContinuousBatcher:
         ids += [r.req_id for r in self.active.values()]
         return ids
 
+    def load_snapshot(self) -> dict:
+        """Cheap load view for the fleet registry heartbeat: row/queue
+        occupancy, KV-pool headroom, and the content hashes of the COW
+        prefixes resident in the pool (the ``prefix_affinity`` routing
+        signal). Host-side counters and host tables only — never touches
+        a device array, so publishing it from a heartbeat thread can't
+        force a device sync mid-decode."""
+        from llmss_tpu.serve.protocol import prefix_hash
+
+        with self._lock:
+            pending = len(self.pending)
+            free_slots = len(self._free)
+        snap = {
+            "rows": self.rows,
+            "inflight_rows": self.rows - free_slots,
+            "pending": pending,
+            "free_slots": free_slots,
+            "free_kv_blocks": None,
+            "kv_blocks_total": None,
+            "prefix_hashes": [],
+        }
+        if self._paged:
+            snap["free_kv_blocks"] = self.allocator.free_blocks
+            snap["kv_blocks_total"] = self.allocator.num_blocks
+            snap["prefix_hashes"] = [
+                prefix_hash(pfx.tokens)
+                for pfx, _blocks in list(self._paged_prefixes.values())
+            ]
+        return snap
+
     def drain_all(self) -> list[str]:
         """Remove every pending and active request and return their ids —
         supervisor teardown: a restarting worker must error these out so no

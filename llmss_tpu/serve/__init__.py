@@ -32,12 +32,20 @@ Fault-injection machinery to exercise all of this lives in
 """
 
 from llmss_tpu.serve.broker import Broker, InProcBroker, RedisBroker
-from llmss_tpu.serve.protocol import GenerateRequest, GenerateResponse
+from llmss_tpu.serve.fleet import FleetHarness, Router
+from llmss_tpu.serve.protocol import (
+    GenerateRequest,
+    GenerateResponse,
+    prefix_hash,
+)
 
 __all__ = [
     "Broker",
+    "FleetHarness",
     "GenerateRequest",
     "GenerateResponse",
     "InProcBroker",
     "RedisBroker",
+    "Router",
+    "prefix_hash",
 ]

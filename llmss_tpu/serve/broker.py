@@ -109,6 +109,64 @@ class Broker(abc.ABC):
         ``GET /metrics``)."""
         return {}
 
+    # -- fleet: worker registry + per-worker routed queues -------------------
+    # Workers register a worker_id with capabilities and publish periodic
+    # load snapshots; routers read the registry to pick a replica and push
+    # onto that worker's routed queue. The SHARED queue stays the default
+    # transport — a deployment that never registers a worker behaves
+    # exactly as before. Defaults are no-ops / shared-queue fallbacks so
+    # minimal Broker implementations keep working.
+
+    # Registry entries older than this with no snapshot refresh are
+    # dropped from ``read_workers`` — a vanished worker ages out of the
+    # fleet view even if nothing ever deregisters it.
+    worker_ttl_s = 60.0
+
+    def register_worker(self, info: dict) -> None:  # noqa: B027
+        """Announce a worker: ``info`` must carry ``worker_id`` plus
+        capabilities (model, kv_layout, kv_blocks, ...). Re-registering
+        merges and refreshes the TTL."""
+
+    def publish_worker_load(self, worker_id: str, snapshot: dict) -> None:  # noqa: B027
+        """Merge a periodic load snapshot (lifecycle state, in-flight
+        rows, free KV blocks, queue depth, resident prefix hashes,
+        heartbeat stamps) into the worker's registry entry and refresh
+        its TTL. Auto-registers unknown ids so a snapshot-only worker
+        is still visible."""
+
+    def deregister_worker(self, worker_id: str) -> None:  # noqa: B027
+        pass
+
+    def read_workers(self) -> dict:
+        """Live registry: ``{worker_id: info-dict}`` with expired entries
+        purged."""
+        return {}
+
+    def push_request_to(self, worker_id: str, req: GenerateRequest) -> None:
+        """Enqueue onto one worker's routed queue. Base fallback: the
+        shared queue (any worker may take it)."""
+        self.push_request(req)
+
+    def routed_depths(self) -> dict:
+        """``{worker_id: depth}`` for non-empty routed queues — the
+        router's freshest backlog signal (snapshots lag by a heartbeat)."""
+        return {}
+
+    def lease_holders(self) -> dict:
+        """``{worker_id: n_leases}`` for leases attributed to a worker id
+        (anonymous shared-queue pops are not counted). Lets the router
+        spot in-flight work held by a worker that has vanished from the
+        registry."""
+        return {}
+
+    def failover_worker(self, worker_id: str) -> list[GenerateRequest]:
+        """Evacuate a dead worker: drain its routed-but-undelivered queue
+        (no delivery attempt consumed — never leased) and force-expire its
+        leases through the standard disposition (deadline-shed and
+        dead-letter terminally answered here; requeue-able ones returned).
+        Returns the requests the caller should re-route to survivors."""
+        return []
+
     def _expiry_disposition(self, req: GenerateRequest) -> str:
         """Policy for a lease that timed out un-acked:
         ``'expired'`` (end-to-end deadline passed — shed),
@@ -191,11 +249,14 @@ class InProcBroker(Broker):
         lease_s: float | None = None,
         max_delivery_attempts: int | None = None,
         response_ttl_s: float | None = None,
+        worker_ttl_s: float | None = None,
     ):
         if lease_s is not None:
             self.lease_s = lease_s
         if max_delivery_attempts is not None:
             self.max_delivery_attempts = max_delivery_attempts
+        if worker_ttl_s is not None:
+            self.worker_ttl_s = worker_ttl_s
         # Responses nobody collects (the client timed out before
         # wait_response) age out like the cancel/tombstone maps — without
         # a TTL they leak forever in a long-lived producer.
@@ -214,12 +275,122 @@ class InProcBroker(Broker):
         # id -> tombstone expiry
         self._dead_streams: dict[str, float] = {}  # guarded_by: self._stream_lock
         self._stream_lock = threading.Lock()
-        self._leases: dict[str, tuple[float, GenerateRequest]] = {}  # guarded_by: self._lease_lock
+        # Lease entries are (expiry, req, worker_id-or-None): worker
+        # attribution lets failover_worker evacuate exactly one worker's
+        # in-flight requests (anonymous shared-queue pops store None).
+        self._leases: dict[str, tuple[float, GenerateRequest, str | None]] = {}  # guarded_by: self._lease_lock
         self._lease_lock = threading.Lock()
         self._dlq: list[GenerateRequest] = []  # guarded_by: self._lease_lock
         self._delivery_counts = {  # guarded_by: self._lease_lock
             "redelivered": 0, "dead_lettered": 0, "deadline_expired": 0,
+            "failover_rerouted": 0,
         }
+        # Fleet state: per-worker routed queues + TTL'd registry.
+        self._routed: dict[str, queue.Queue] = {}  # guarded_by: self._route_lock
+        self._route_lock = threading.Lock()
+        self._workers: dict[str, dict] = {}  # guarded_by: self._worker_lock
+        # worker_id -> monotonic registry-entry expiry
+        self._worker_expiry: dict[str, float] = {}  # guarded_by: self._worker_lock
+        self._worker_lock = threading.Lock()
+
+    # -- fleet registry ------------------------------------------------------
+
+    def register_worker(self, info: dict) -> None:
+        wid = info["worker_id"]
+        with self._worker_lock:
+            entry = self._workers.setdefault(wid, {})
+            entry.update(info)
+            self._worker_expiry[wid] = time.monotonic() + self.worker_ttl_s
+
+    def publish_worker_load(self, worker_id: str, snapshot: dict) -> None:
+        with self._worker_lock:
+            entry = self._workers.setdefault(worker_id, {"worker_id": worker_id})
+            entry.update(snapshot)
+            self._worker_expiry[worker_id] = (
+                time.monotonic() + self.worker_ttl_s
+            )
+
+    def deregister_worker(self, worker_id: str) -> None:
+        with self._worker_lock:
+            self._workers.pop(worker_id, None)
+            self._worker_expiry.pop(worker_id, None)
+
+    def read_workers(self) -> dict:
+        now = time.monotonic()
+        with self._worker_lock:
+            for wid in [
+                w for w, t in self._worker_expiry.items() if t <= now
+            ]:
+                del self._worker_expiry[wid]
+                self._workers.pop(wid, None)
+            return {wid: dict(info) for wid, info in self._workers.items()}
+
+    def push_request_to(self, worker_id: str, req: GenerateRequest) -> None:
+        with self._route_lock:
+            q = self._routed.setdefault(worker_id, queue.Queue())
+        q.put(req)
+
+    def routed_depths(self) -> dict:
+        with self._route_lock:
+            return {
+                wid: q.qsize() for wid, q in self._routed.items()
+                if q.qsize() > 0
+            }
+
+    def lease_holders(self) -> dict:
+        holders: dict[str, int] = {}
+        with self._lease_lock:
+            for _t, _req, wid in self._leases.values():
+                if wid is not None:
+                    holders[wid] = holders.get(wid, 0) + 1
+        return holders
+
+    def failover_worker(self, worker_id: str) -> list[GenerateRequest]:
+        out: list[GenerateRequest] = []
+        # Routed-but-undelivered: never leased, so no delivery attempt is
+        # consumed — they simply move to a survivor.
+        with self._route_lock:
+            q = self._routed.pop(worker_id, None)
+        if q is not None:
+            while True:
+                try:
+                    out.append(q.get_nowait())
+                except queue.Empty:
+                    break
+        # Leased in-flight: force-expire through the standard disposition
+        # so deadline-shed / dead-letter semantics match a natural expiry.
+        with self._lease_lock:
+            held = [
+                (rid, req) for rid, (_t, req, wid) in self._leases.items()
+                if wid == worker_id
+            ]
+            for rid, _ in held:
+                del self._leases[rid]
+        for _rid, req in held:
+            disp = self._expiry_disposition(req)
+            if disp == "expired":
+                with self._lease_lock:
+                    self._delivery_counts["deadline_expired"] += 1
+                self.push_response(GenerateResponse(
+                    id=req.id, error="deadline exceeded before completion",
+                ))
+            elif disp == "dead-letter":
+                with self._lease_lock:
+                    self._delivery_counts["dead_lettered"] += 1
+                    self._dlq.append(req)
+                self.push_response(GenerateResponse(
+                    id=req.id,
+                    error=(
+                        f"dead-lettered after {req.delivery_attempts} "
+                        "delivery attempts"
+                    ),
+                ))
+            else:
+                out.append(req)
+        if out:
+            with self._lease_lock:
+                self._delivery_counts["failover_rerouted"] += len(out)
+        return out
 
     def push_stream(self, request_id: str, token_ids: list[int]) -> None:
         with self._stream_lock:
@@ -276,17 +447,34 @@ class InProcBroker(Broker):
     def push_request(self, req: GenerateRequest) -> None:
         self._requests.put(req)
 
-    def pop_request(self, timeout: float = 0.0) -> GenerateRequest | None:
+    def pop_request(
+        self, timeout: float = 0.0, worker_id: str | None = None,
+    ) -> GenerateRequest | None:
         self.reap_expired()
-        try:
-            req = self._requests.get(timeout=timeout) if timeout else (
-                self._requests.get_nowait()
-            )
-        except queue.Empty:
-            return None
+        req = None
+        if worker_id is not None:
+            # Routed work first: requests a router pinned to THIS worker
+            # (e.g. prefix affinity) must not rot behind shared-queue
+            # traffic any worker could take.
+            with self._route_lock:
+                q = self._routed.get(worker_id)
+            if q is not None:
+                try:
+                    req = q.get_nowait()
+                except queue.Empty:
+                    req = None
+        if req is None:
+            try:
+                req = self._requests.get(timeout=timeout) if timeout else (
+                    self._requests.get_nowait()
+                )
+            except queue.Empty:
+                return None
         req.delivery_attempts += 1
         with self._lease_lock:
-            self._leases[req.id] = (time.monotonic() + self.lease_s, req)
+            self._leases[req.id] = (
+                time.monotonic() + self.lease_s, req, worker_id,
+            )
         return req
 
     def touch_requests(self, request_ids) -> None:
@@ -295,13 +483,13 @@ class InProcBroker(Broker):
             for rid in request_ids:
                 held = self._leases.get(rid)
                 if held is not None:
-                    self._leases[rid] = (now + self.lease_s, held[1])
+                    self._leases[rid] = (now + self.lease_s, held[1], held[2])
 
     def reap_expired(self) -> int:
         now = time.monotonic()
         with self._lease_lock:
             dead = [
-                (rid, req) for rid, (t, req) in self._leases.items()
+                (rid, req) for rid, (t, req, _wid) in self._leases.items()
                 if t <= now
             ]
             for rid, _ in dead:
@@ -345,7 +533,12 @@ class InProcBroker(Broker):
         return n
 
     def queue_depth(self) -> int:
-        return self._requests.qsize()
+        # Backlog = shared queue + every routed queue: admission control
+        # must see routed work too (with no routed queues this is exactly
+        # the pre-fleet value).
+        with self._route_lock:
+            routed = sum(q.qsize() for q in self._routed.values())
+        return self._requests.qsize() + routed
 
     def dlq_depth(self) -> int:
         with self._lease_lock:
@@ -359,9 +552,10 @@ class InProcBroker(Broker):
         return [dataclasses.asdict(r) for r in recent]
 
     def delivery_stats(self) -> dict:
+        depth = self.queue_depth()
         with self._lease_lock:
             return {
-                "queue_depth": self._requests.qsize(),
+                "queue_depth": depth,
                 "inflight": len(self._leases),
                 "dlq_depth": len(self._dlq),
                 **self._delivery_counts,
@@ -427,7 +621,8 @@ class RedisBroker(Broker):
                  request_queue: str = "pqueue", response_prefix: str = "squeue",
                  cancel_prefix: str = "cancelled", *, client=None,
                  worker_id: str | None = None, lease_s: float | None = None,
-                 max_delivery_attempts: int | None = None):
+                 max_delivery_attempts: int | None = None,
+                 worker_ttl_s: float | None = None):
         if client is None:
             import redis  # gated: optional dependency
 
@@ -440,12 +635,136 @@ class RedisBroker(Broker):
             self.lease_s = lease_s
         if max_delivery_attempts is not None:
             self.max_delivery_attempts = max_delivery_attempts
+        if worker_ttl_s is not None:
+            self.worker_ttl_s = worker_ttl_s
         import uuid
 
         self._worker_id = worker_id or uuid.uuid4().hex[:8]
         self._lease_prefix = f"{request_queue}:lease"
         self._dlq_key = f"{request_queue}:dlq"
         self._stats_prefix = f"{request_queue}:stats"
+        # Fleet keys: registry entries at {pqueue}:worker:{id}, per-worker
+        # routed queues at {pqueue}:w:{id} (the glob "{pqueue}:w:*" cannot
+        # match "{pqueue}:worker:*" — the segment after "w" differs).
+        self._worker_prefix = f"{request_queue}:worker"
+        self._routed_prefix = f"{request_queue}:w"
+
+    # -- fleet registry ------------------------------------------------------
+    # Worker ids must not contain ":" — they are embedded as key segments
+    # in lease / registry / routed-queue keys.
+
+    def _worker_key(self, worker_id: str) -> str:
+        return f"{self._worker_prefix}:{worker_id}"
+
+    def _routed_key(self, worker_id: str) -> str:
+        return f"{self._routed_prefix}:{worker_id}"
+
+    def _merge_worker(self, worker_id: str, patch: dict) -> None:
+        import json
+
+        key = self._worker_key(worker_id)
+        raw = self._r.get(key)
+        entry = json.loads(raw) if raw else {}
+        entry.update(patch)
+        entry["worker_id"] = worker_id
+        # Expiry is judged against the shared Redis server clock (same
+        # scheme as leases: embedded stamp is truth, the key TTL is only a
+        # GC backstop — and stays integral for real redis clients).
+        entry["_expires_at"] = self._now() + self.worker_ttl_s
+        self._r.set(
+            key, json.dumps(entry),
+            ex=max(60, int(self.worker_ttl_s * 20)),
+        )
+
+    def register_worker(self, info: dict) -> None:
+        self._merge_worker(info["worker_id"], info)
+
+    def publish_worker_load(self, worker_id: str, snapshot: dict) -> None:
+        self._merge_worker(worker_id, snapshot)
+
+    def deregister_worker(self, worker_id: str) -> None:
+        self._r.delete(self._worker_key(worker_id))
+
+    def read_workers(self) -> dict:
+        import json
+
+        now = self._now()
+        out: dict[str, dict] = {}
+        for key in list(self._r.scan_iter(match=f"{self._worker_prefix}:*")):
+            raw = self._r.get(key)
+            if raw is None:
+                continue
+            entry = json.loads(raw)
+            if float(entry.get("_expires_at", 0.0)) <= now:
+                self._r.delete(key)
+                continue
+            entry.pop("_expires_at", None)
+            out[entry["worker_id"]] = entry
+        return out
+
+    def push_request_to(self, worker_id: str, req: GenerateRequest) -> None:
+        self._r.lpush(self._routed_key(worker_id), req.to_json())
+
+    def routed_depths(self) -> dict:
+        out: dict[str, int] = {}
+        skip = len(self._routed_prefix) + 1
+        for key in list(self._r.scan_iter(match=f"{self._routed_prefix}:*")):
+            k = key.decode() if isinstance(key, bytes) else str(key)
+            depth = int(self._r.llen(k))
+            if depth:
+                out[k[skip:]] = depth
+        return out
+
+    def lease_holders(self) -> dict:
+        holders: dict[str, int] = {}
+        skip = len(self._lease_prefix) + 1
+        for key in list(self._r.scan_iter(match=f"{self._lease_prefix}:*")):
+            k = key.decode() if isinstance(key, bytes) else str(key)
+            wid = k[skip:].rsplit(":", 1)[0]
+            holders[wid] = holders.get(wid, 0) + 1
+        return holders
+
+    def failover_worker(self, worker_id: str) -> list[GenerateRequest]:
+        import json
+
+        out: list[GenerateRequest] = []
+        while True:  # routed-but-undelivered: no attempt consumed
+            payload = self._r.rpop(self._routed_key(worker_id))
+            if not payload:
+                break
+            out.append(GenerateRequest.from_json(payload))
+        # Leased in-flight: claim-by-delete (reaper-safe), standard
+        # disposition — requeue-able requests return to the caller for
+        # re-routing instead of landing back on the shared queue.
+        match = f"{self._lease_prefix}:{worker_id}:*"
+        for key in list(self._r.scan_iter(match=match)):
+            raw = self._r.get(key)
+            if raw is None:
+                continue
+            if not self._r.delete(key):
+                continue  # a reaper claimed it concurrently
+            req = GenerateRequest.from_json(json.loads(raw)["req"])
+            disp = self._expiry_disposition(req)
+            if disp == "expired":
+                self._r.incr(f"{self._stats_prefix}:deadline_expired")
+                self.push_response(GenerateResponse(
+                    id=req.id, error="deadline exceeded before completion",
+                ))
+            elif disp == "dead-letter":
+                self._r.incr(f"{self._stats_prefix}:dead_lettered")
+                self._r.lpush(self._dlq_key, req.to_json())
+                self.push_response(GenerateResponse(
+                    id=req.id,
+                    error=(
+                        f"dead-lettered after {req.delivery_attempts} "
+                        "delivery attempts"
+                    ),
+                ))
+            else:
+                out.append(req)
+        for _ in out:
+            self._r.incr(f"{self._stats_prefix}:failover_rerouted")
+        return out
 
     # -- lease plumbing -----------------------------------------------------
 
@@ -556,7 +875,10 @@ class RedisBroker(Broker):
         return n
 
     def queue_depth(self) -> int:
-        return int(self._r.llen(self._rq))
+        # Shared queue + every routed queue (admission control must see
+        # routed backlog too); no routed queues → exactly the old value.
+        routed = sum(self.routed_depths().values())
+        return int(self._r.llen(self._rq)) + routed
 
     def dlq_depth(self) -> int:
         return int(self._r.llen(self._dlq_key))
@@ -570,7 +892,10 @@ class RedisBroker(Broker):
         ]
 
     def delivery_stats(self) -> dict:
-        names = ("redelivered", "dead_lettered", "deadline_expired")
+        names = (
+            "redelivered", "dead_lettered", "deadline_expired",
+            "failover_rerouted",
+        )
         vals = self._r.mget([f"{self._stats_prefix}:{k}" for k in names])
         inflight = sum(
             1 for _ in self._r.scan_iter(match=f"{self._lease_prefix}:*")
@@ -623,15 +948,28 @@ class RedisBroker(Broker):
     def push_request(self, req: GenerateRequest) -> None:
         self._r.lpush(self._rq, req.to_json())
 
-    def pop_request(self, timeout: float = 0.0) -> GenerateRequest | None:
+    def pop_request(
+        self, timeout: float = 0.0, worker_id: str | None = None,
+    ) -> GenerateRequest | None:
         # Lazy reaper: any live worker popping work also recovers expired
         # leases (including a dead worker's) — no dedicated reaper process.
         self.reap_expired()
-        if timeout:
-            item = self._r.brpop(self._rq, timeout=timeout)
-            payload = item[1] if item else None
-        else:
-            payload = self._r.rpop(self._rq)
+        payload = None
+        if worker_id is not None:
+            if worker_id != self._worker_id:
+                # A consumer's fleet id IS its lease identity: adopt it so
+                # acks (push_response deletes this worker's lease key) and
+                # failover attribution line up with the routed queue.
+                self._worker_id = worker_id
+            # Routed work first (router pinned it here — e.g. prefix
+            # affinity); the shared queue only when the routed one is dry.
+            payload = self._r.rpop(self._routed_key(worker_id))
+        if not payload:
+            if timeout:
+                item = self._r.brpop(self._rq, timeout=timeout)
+                payload = item[1] if item else None
+            else:
+                payload = self._r.rpop(self._rq)
         if not payload:
             return None
         req = GenerateRequest.from_json(payload)

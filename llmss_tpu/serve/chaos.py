@@ -61,14 +61,17 @@ class ChaosWorkerHost:
     ``HardKill`` is instant death — the worker object is abandoned with no
     abort path (its leased requests are recovered only by broker
     redelivery) and a fresh worker is spawned after ``respawn_delay_s``.
-    Any ordinary ``Exception`` is a harness bug: recorded and re-raised so
-    tests fail loudly instead of spinning.
+    With ``respawn=False`` the first kill is permanent (the "machine" never
+    comes back) — the shape fleet-failover tests need. Any ordinary
+    ``Exception`` is a harness bug: recorded and re-raised so tests fail
+    loudly instead of spinning.
     """
 
     def __init__(self, worker_factory: Callable[[], object], *,
-                 respawn_delay_s: float = 0.05):
+                 respawn_delay_s: float = 0.05, respawn: bool = True):
         self.worker_factory = worker_factory
         self.respawn_delay_s = respawn_delay_s
+        self.respawn = respawn
         self.kills = 0
         self.spawns = 0
         self.error: str | None = None
@@ -85,6 +88,8 @@ class ChaosWorkerHost:
             except HardKill as e:
                 self.kills += 1
                 logger.debug("chaos host: worker hard-killed (%s)", e)
+                if not self.respawn:
+                    return
                 if self._stop.wait(self.respawn_delay_s):
                     return
             except Exception as e:  # noqa: BLE001 — surface harness bugs
@@ -143,11 +148,11 @@ class ChaosBroker:
     def __getattr__(self, name):
         return getattr(self.inner, name)
 
-    def pop_request(self, timeout: float = 0.0):
+    def pop_request(self, timeout: float = 0.0, worker_id: str | None = None):
         if self.pop_fail_prob and self._rng.random() < self.pop_fail_prob:
             self.faults["dropped_pops"] += 1
             return None
-        req = self.inner.pop_request(timeout)
+        req = self.inner.pop_request(timeout, worker_id=worker_id)
         if (
             req is not None
             and self.kill_after_pop_prob
@@ -191,17 +196,24 @@ class ScriptedEngine:
       ``NAN_TOKEN`` is *poisoned* — ``on_poisoned(row)`` fires and the row
       produces no tokens, while batch-mates get their exact solo tokens.
       Mirrors the real engine's jitted NaN/inf containment surface.
+    - ``kill_switch``: an externally-held Event checked once per decode
+      chunk; once set, the next chunk boundary raises ``HardKill`` — a
+      worker killed *mid-decode, while holding leases*, on a trigger the
+      test controls (fleet failover tests kill exactly one replica this
+      way).
     """
 
     def __init__(self, *, kill_on_poison: bool = False,
                  chunk_delay_s: float = 0.0,
                  hang_at: int | None = None, hang_s: float = 30.0,
-                 nan_at: int | None = None):
+                 nan_at: int | None = None,
+                 kill_switch: threading.Event | None = None):
         self.kill_on_poison = kill_on_poison
         self.chunk_delay_s = chunk_delay_s
         self.hang_at = hang_at
         self.hang_s = hang_s
         self.nan_at = nan_at
+        self.kill_switch = kill_switch
         self.metrics = EngineMetrics()
         self.generate_calls = 0
         self.max_seq_len = 4096
@@ -249,6 +261,8 @@ class ScriptedEngine:
                 on_poisoned(row)
         steps = max(g.max_new_tokens for g in gens) if gens else 0
         for start in range(0, steps, max(chunk_steps, 1)):
+            if self.kill_switch is not None and self.kill_switch.is_set():
+                raise HardKill("chaos: kill switch tripped mid-decode")
             if self.chunk_delay_s:
                 time.sleep(self.chunk_delay_s)
             if cancel_poll is not None:

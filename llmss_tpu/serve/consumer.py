@@ -23,9 +23,28 @@ import time
 
 from llmss_tpu.engine import DecodeEngine, GenerationParams
 from llmss_tpu.serve.broker import Broker
-from llmss_tpu.serve.protocol import GenerateRequest, GenerateResponse
+from llmss_tpu.serve.protocol import (
+    STATE_DRAINING,
+    STATE_READY,
+    GenerateRequest,
+    GenerateResponse,
+    prefix_hash,
+)
 
 logger = logging.getLogger("llmss_tpu.serve")
+
+
+def worker_capabilities(worker_id: str, engine) -> dict:
+    """Registration payload: identity + what this replica can serve.
+    Tolerant of engine stand-ins (ScriptedEngine) that lack the attrs."""
+    cfg = getattr(engine, "cfg", None)
+    return {
+        "worker_id": worker_id,
+        "model": getattr(cfg, "model_type", None) or type(engine).__name__,
+        "kv_layout": getattr(engine, "kv_layout", None),
+        "kv_blocks": getattr(engine, "kv_blocks", None),
+        "max_seq_len": getattr(engine, "max_seq_len", None),
+    }
 
 
 def encode_request(tokenizer, req: GenerateRequest) -> list[int]:
@@ -59,12 +78,22 @@ class Worker:
         poll_timeout_s: float = 0.2,
         pad_batch: bool = True,
         chunk_steps: int = 8,
+        worker_id: str | None = None,
+        snapshot_interval_s: float = 1.0,
     ):
         self.engine = engine
         self.broker = broker
         self.tokenizer = tokenizer
         self.batch_size = batch_size
         self.poll_timeout_s = poll_timeout_s
+        # Fleet identity: with a worker_id this worker registers in the
+        # broker's worker registry, publishes periodic load snapshots, and
+        # prefers its routed queue over the shared one. Without (default),
+        # behavior is exactly the single-worker shared-queue stack.
+        self.worker_id = worker_id
+        self.snapshot_interval_s = snapshot_interval_s
+        self._last_snapshot_t = 0.0
+        self._inflight_rows = 0
         # Decode steps per host round-trip (engine.generate chunking):
         # amortizes dispatch + token-fetch latency; cancellation latency
         # becomes one chunk instead of one step.
@@ -85,6 +114,61 @@ class Worker:
         # watchdog compares it against time.monotonic() from another thread;
         # the heartbeat converts it to wall clock only at publish time.
         self.last_progress_ts = 0.0
+        if worker_id is not None:
+            self.register()
+
+    def register(self) -> None:
+        """(Re-)announce this worker in the fleet registry — called at
+        construction and safe to call again after a registry TTL expiry."""
+        self.broker.register_worker(
+            worker_capabilities(self.worker_id, self.engine)
+        )
+        self._publish_load()
+
+    def load_snapshot(self) -> dict:
+        """Registry heartbeat payload (host counters only). Carries the
+        same ``heartbeat_ts``/``heartbeat_s`` contract as the supervisor
+        block so ``evaluate_worker_health`` judges fleet entries too."""
+        import time as _time
+
+        return {
+            "state": STATE_DRAINING if self.draining else STATE_READY,
+            "alive": True,
+            "rows": self.batch_size,
+            "inflight_rows": self._inflight_rows,
+            "free_slots": self.batch_size - self._inflight_rows,
+            "queue_depth": 0,  # batch worker holds nothing between batches
+            "free_kv_blocks": None,
+            "kv_blocks_total": None,
+            "prefix_hashes": [],
+            "heartbeat_s": self.snapshot_interval_s,
+            # Cross-process staleness stamp: the router/producer compute
+            # `time.time() - heartbeat_ts` in another process, and
+            # monotonic epochs don't line up across processes.
+            "heartbeat_ts": _time.time(),  # lint: ignore[wall-clock-timer]
+        }
+
+    def _publish_load(self) -> None:
+        if self.worker_id is not None:
+            self._last_snapshot_t = time.monotonic()
+            self.broker.publish_worker_load(
+                self.worker_id, self.load_snapshot()
+            )
+
+    def _maybe_publish_load(self) -> None:
+        if (
+            self.worker_id is not None
+            and time.monotonic() - self._last_snapshot_t
+            >= self.snapshot_interval_s
+        ):
+            self._publish_load()
+
+    def _pop(self, timeout: float = 0.0) -> GenerateRequest | None:
+        if self.worker_id is None:
+            return self.broker.pop_request(timeout=timeout)
+        return self.broker.pop_request(
+            timeout=timeout, worker_id=self.worker_id
+        )
 
     def begin_drain(self) -> None:
         self.draining = True
@@ -113,12 +197,12 @@ class Worker:
         """Block briefly for one request, then drain the queue up to
         batch_size (the reference instead spins at batch_size=1,
         consumer_server.py:75-81)."""
-        first = self.broker.pop_request(timeout=self.poll_timeout_s)
+        first = self._pop(timeout=self.poll_timeout_s)
         if first is None:
             return []
         batch = [first]
         while len(batch) < self.batch_size:
-            nxt = self.broker.pop_request()
+            nxt = self._pop()
             if nxt is None:
                 break
             batch.append(nxt)
@@ -128,6 +212,7 @@ class Worker:
 
     def run_once(self) -> int:
         self.last_progress_ts = time.monotonic()
+        self._maybe_publish_load()
         if self.draining:
             return 0  # stop leasing; nothing held between batches
         batch = self._gather()
@@ -190,6 +275,7 @@ class Worker:
             # a dead worker (same cadence, one decode chunk).
             self.last_progress_ts = time.monotonic()
             self.broker.publish_metrics(self.engine.metrics.to_dict())
+            self._maybe_publish_load()
             self.broker.touch_requests([r.id for r in ok])
             hits = self.broker.check_cancelled(
                 [r.id for r in ok if r.id not in mid_cancelled]
@@ -207,6 +293,7 @@ class Worker:
                 self.broker.push_stream(ok[row].id, new_toks)
 
         poisoned_rows: set[int] = set()
+        self._inflight_rows = n_live
         try:
             outs = self.engine.generate(
                 prompts, gens, cancel_poll=cancel_poll,
@@ -225,6 +312,8 @@ class Worker:
             # not only after the next successful batch.
             self.broker.publish_metrics(self.engine.metrics.to_dict())
             return len(batch)
+        finally:
+            self._inflight_rows = 0
 
         for row, (req, toks) in enumerate(zip(ok, outs)):
             if row in poisoned_rows:
@@ -281,6 +370,8 @@ class ContinuousWorker:
         poll_timeout_s: float = 0.02,
         chunk_steps: int = 8,
         chunk_steps_low: int | None = None,
+        worker_id: str | None = None,
+        snapshot_interval_s: float = 1.0,
     ):
         from llmss_tpu.engine.scheduler import ContinuousBatcher
 
@@ -301,6 +392,64 @@ class ContinuousWorker:
         # from device-resident KV instead of re-prefilling the prefix.
         self._prefixes: "dict[tuple, object]" = {}
         self.max_prefixes = 4
+        # Fleet identity (see Worker): registry + load snapshots + routed
+        # queue preference; None = pre-fleet single-worker behavior.
+        self.worker_id = worker_id
+        self.snapshot_interval_s = snapshot_interval_s
+        self._last_snapshot_t = 0.0
+        if worker_id is not None:
+            self.register()
+
+    def register(self) -> None:
+        """(Re-)announce this worker in the fleet registry — called at
+        construction and safe to call again after a registry TTL expiry."""
+        self.broker.register_worker(
+            worker_capabilities(self.worker_id, self.engine)
+        )
+        self._publish_load()
+
+    def load_snapshot(self) -> dict:
+        """Registry heartbeat: the batcher's host-side occupancy/KV view
+        plus lifecycle and the resident prefix hashes from BOTH layers —
+        the batcher's paged COW pool and this worker's dense prefix LRU
+        (either one makes a prefix-affinity route a prefill hit)."""
+        import time as _time
+
+        snap = self.batcher.load_snapshot()
+        hashes = set(snap.get("prefix_hashes") or [])
+        hashes.update(prefix_hash(k) for k in self._prefixes)
+        snap.update({
+            "state": STATE_DRAINING if self.draining else STATE_READY,
+            "alive": True,
+            "queue_depth": snap.get("pending", 0),
+            "prefix_hashes": sorted(hashes),
+            "heartbeat_s": self.snapshot_interval_s,
+            # Cross-process staleness stamp (see Worker.load_snapshot).
+            "heartbeat_ts": _time.time(),  # lint: ignore[wall-clock-timer]
+        })
+        return snap
+
+    def _publish_load(self) -> None:
+        if self.worker_id is not None:
+            self._last_snapshot_t = time.monotonic()
+            self.broker.publish_worker_load(
+                self.worker_id, self.load_snapshot()
+            )
+
+    def _maybe_publish_load(self) -> None:
+        if (
+            self.worker_id is not None
+            and time.monotonic() - self._last_snapshot_t
+            >= self.snapshot_interval_s
+        ):
+            self._publish_load()
+
+    def _pop(self, timeout: float = 0.0) -> GenerateRequest | None:
+        if self.worker_id is None:
+            return self.broker.pop_request(timeout=timeout)
+        return self.broker.pop_request(
+            timeout=timeout, worker_id=self.worker_id
+        )
 
     def prewarm(
         self, seq_buckets: list[int] | None = None,
@@ -314,7 +463,7 @@ class ContinuousWorker:
     def _drain_broker(self) -> int:
         n = 0
         while True:
-            req = self.broker.pop_request(
+            req = self._pop(
                 timeout=self.poll_timeout_s if self.batcher.idle and n == 0
                 else 0.0
             )
@@ -441,6 +590,7 @@ class ContinuousWorker:
             # The batcher frees the row at the top of its next step; the
             # request's done_cb fires with the tokens produced so far.
             self.batcher.cancel(rid)
+        self._maybe_publish_load()
         n = 0 if self.draining else self._drain_broker()
         self.batcher.step()
         self._publish_counter += 1
@@ -511,6 +661,18 @@ def main(argv=None):
              "redelivered (poison-request quarantine)",
     )
     parser.add_argument(
+        "--worker_id", default=None,
+        help="fleet identity (no ':' allowed): register in the broker's "
+             "worker registry, publish load snapshots, and serve this "
+             "worker's routed queue before the shared one; omit for "
+             "plain single-worker shared-queue serving",
+    )
+    parser.add_argument(
+        "--snapshot_interval_s", type=float, default=1.0,
+        help="load-snapshot publish cadence when --worker_id is set "
+             "(routers treat a worker as stale after 3x this)",
+    )
+    parser.add_argument(
         "--supervise", action="store_true",
         help="run under the crash-restart supervisor (heartbeats + capped "
              "exponential backoff)",
@@ -549,18 +711,23 @@ def main(argv=None):
     broker = RedisBroker(
         args.redis_host, args.redis_port, lease_s=args.lease_s,
         max_delivery_attempts=args.max_delivery_attempts,
+        # Fleet id doubles as the lease identity so routed queues, lease
+        # attribution, and failover all line up on one name.
+        worker_id=args.worker_id,
     )
 
     def make_worker():
         if args.continuous:
             w = ContinuousWorker(
                 engine, broker, tokenizer, rows=args.batch_size,
-                chunk_steps=args.chunk_steps,
+                chunk_steps=args.chunk_steps, worker_id=args.worker_id,
+                snapshot_interval_s=args.snapshot_interval_s,
             )
         else:
             w = Worker(
                 engine, broker, tokenizer, batch_size=args.batch_size,
-                chunk_steps=args.chunk_steps,
+                chunk_steps=args.chunk_steps, worker_id=args.worker_id,
+                snapshot_interval_s=args.snapshot_interval_s,
             )
         # Inside the factory so supervised restarts (fresh batcher, fresh
         # jit wrappers) also come up fully compiled.

@@ -1,0 +1,348 @@
+"""Fleet routing: worker registry view, routing policies, failover.
+
+The serving stack below this module is structurally single-replica: one
+anonymous shared queue, one supervisor health block, no notion of *which*
+worker holds what KV state. This module adds the fleet layer on top of the
+broker's registry/routed-queue substrate (``serve/broker.py``):
+
+- **Registry**: workers register a ``worker_id`` with capabilities (model,
+  kv_layout, kv_blocks) and publish periodic load snapshots — lifecycle
+  state, in-flight rows, free KV blocks, queue depth, resident prefix
+  hashes, and the same ``heartbeat_ts``/``heartbeat_s`` stamps the
+  supervisor health block uses, so one health policy
+  (``producer.evaluate_worker_health``) judges both.
+- **Router**: picks a replica per request and pushes onto its routed
+  queue. Policies:
+
+  - ``round_robin``: stable rotation over routable replicas.
+  - ``least_loaded``: fewest (in-flight rows + routed backlog), breaking
+    ties toward the most free KV blocks. Backlog comes from the broker's
+    live ``routed_depths`` — snapshots lag by a heartbeat, and routing a
+    burst on stale snapshots would dogpile one replica.
+  - ``prefix_affinity``: requests sharing a prompt-prefix hash
+    (``protocol.prefix_hash``) ride to the replica already holding that
+    COW prefix — sticky owner map first, then the snapshots' resident
+    ``prefix_hashes``, then least-loaded (which becomes the new owner).
+    A shared system prompt is prefilled once per owning replica instead
+    of once per replica per LRU eviction.
+
+- **Failover**: a registered worker that has gone ``dead`` / stale /
+  unhealthy per ``evaluate_worker_health`` — or a routed queue whose
+  worker has vanished from the registry entirely — is evacuated via
+  ``broker.failover_worker``: routed-but-undelivered requests move
+  wholesale; leased in-flight ones re-enter through the standard
+  at-least-once disposition (deadline-shed and dead-letter answered
+  terminally, the rest re-routed to survivors with the dead worker
+  naturally excluded, since it is no longer routable).
+
+If no replica is routable the router falls back to the shared queue —
+never drops — so a fleet that scales to zero degrades to exactly the
+pre-fleet behavior.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+
+from llmss_tpu.serve.broker import Broker
+from llmss_tpu.serve.chaos import ChaosWorkerHost
+from llmss_tpu.serve.protocol import (
+    STATE_DEAD,
+    STATE_READY,
+    GenerateRequest,
+    prefix_hash,
+)
+
+
+def _worker_health(info: dict, stale_factor: float = 3.0) -> tuple[int, dict]:
+    """(status_code, body) for one registry entry, under the same policy
+    as producer /health (lazy import: producer imports this module's
+    helpers for GET /fleet, so neither may import the other at load)."""
+    from llmss_tpu.serve.producer import evaluate_worker_health
+
+    code, body, _ = evaluate_worker_health(info, True, stale_factor)
+    return code, body
+
+
+def routable_workers(
+    broker: Broker, stale_factor: float = 3.0,
+) -> dict[str, dict]:
+    """Registry entries that may take new work right now: healthy per
+    ``evaluate_worker_health`` AND lifecycle ``ready`` (a ``starting``
+    worker heartbeats but is still prewarming)."""
+    out = {}
+    for wid, info in broker.read_workers().items():
+        code, _body = _worker_health(info, stale_factor)
+        if code == 200 and info.get("state", STATE_READY) == STATE_READY:
+            out[wid] = info
+    return out
+
+
+def fleet_status(
+    broker: Broker, router: "Router | None" = None,
+    stale_factor: float = 3.0,
+) -> dict:
+    """Per-worker detail + fleet summary (producer ``GET /fleet``)."""
+    depths = broker.routed_depths()
+    holders = broker.lease_holders()
+    workers = {}
+    ready = 0
+    for wid, info in sorted(broker.read_workers().items()):
+        code, body = _worker_health(info, stale_factor)
+        routable = code == 200 and info.get("state", STATE_READY) == STATE_READY
+        ready += int(routable)
+        workers[wid] = {
+            **info,
+            "health": body.get("status"),
+            "routable": routable,
+            "routed_queue_depth": depths.get(wid, 0),
+            "leases_held": holders.get(wid, 0),
+        }
+    out = {
+        "workers": workers,
+        "ready": ready,
+        "queue_depth": broker.queue_depth(),
+    }
+    if router is not None:
+        out["router"] = router.stats()
+    return out
+
+
+class Router:
+    """Policy-driven request placement over the broker's worker registry.
+
+    Thread-safe: producer handler threads call ``submit`` concurrently,
+    and ``stats`` is read from /metrics handlers, so all mutable routing
+    state lives under one lock.
+    """
+
+    POLICIES = ("round_robin", "least_loaded", "prefix_affinity")
+
+    def __init__(
+        self,
+        broker: Broker,
+        policy: str = "least_loaded",
+        *,
+        stale_factor: float = 3.0,
+        failover_check_s: float = 1.0,
+    ):
+        if policy not in self.POLICIES:
+            raise ValueError(
+                f"unknown policy {policy!r}; choose from {self.POLICIES}"
+            )
+        self.broker = broker
+        self.policy = policy
+        self.stale_factor = stale_factor
+        # Failover sweeps are time-gated: every submit piggybacks a cheap
+        # check, the registry scan runs at most once per interval.
+        self.failover_check_s = failover_check_s
+        self._lock = threading.Lock()
+        self._rr_next = 0  # guarded_by: self._lock
+        # prefix hash -> worker that owns (built) that COW prefix
+        self._prefix_owner: dict[str, str] = {}  # guarded_by: self._lock
+        self._next_failover = 0.0  # guarded_by: self._lock
+        self._counts = {  # guarded_by: self._lock
+            "routed_total": 0,
+            "shared_fallback": 0,
+            "failover_reroutes": 0,
+            "affinity_hits": 0,
+            "affinity_misses": 0,
+        }
+        self._routed_by_worker: dict[str, int] = {}  # guarded_by: self._lock
+
+    # -- policies ------------------------------------------------------------
+
+    def _least_loaded(self, infos: dict, depths: dict) -> str:
+        def load(wid: str):
+            info = infos[wid]
+            backlog = (
+                (info.get("inflight_rows") or 0)
+                + (info.get("queue_depth") or 0)  # worker-internal pending
+                + depths.get(wid, 0)              # routed, not yet popped
+            )
+            headroom = (
+                info.get("free_kv_blocks")
+                if info.get("free_kv_blocks") is not None
+                else info.get("free_slots")
+            )
+            # Fewest queued+running first; tie-break toward the most KV
+            # headroom, then lexical id for determinism.
+            return (backlog, -(headroom or 0), wid)
+
+        return min(infos, key=load)
+
+    def _round_robin(self, infos: dict) -> str:
+        order = sorted(infos)
+        with self._lock:
+            wid = order[self._rr_next % len(order)]
+            self._rr_next += 1
+        return wid
+
+    def _prefix_affinity(self, req: GenerateRequest, infos: dict,
+                         depths: dict) -> str:
+        if not req.prefix_token_ids:
+            return self._least_loaded(infos, depths)
+        h = prefix_hash(req.prefix_token_ids)
+        with self._lock:
+            owner = self._prefix_owner.get(h)
+        if owner not in infos:
+            # Sticky owner gone (or never set): the snapshots know which
+            # replicas currently hold the prefix resident.
+            owner = next(
+                (
+                    wid for wid, info in sorted(infos.items())
+                    if h in (info.get("prefix_hashes") or ())
+                ),
+                None,
+            )
+        with self._lock:
+            if owner is not None:
+                self._counts["affinity_hits"] += 1
+            else:
+                self._counts["affinity_misses"] += 1
+        if owner is None:
+            owner = self._least_loaded(infos, depths)
+        with self._lock:
+            self._prefix_owner[h] = owner
+        return owner
+
+    def _pick(self, req: GenerateRequest, infos: dict) -> str:
+        depths = self.broker.routed_depths()
+        if self.policy == "round_robin":
+            return self._round_robin(infos)
+        if self.policy == "prefix_affinity":
+            return self._prefix_affinity(req, infos, depths)
+        return self._least_loaded(infos, depths)
+
+    # -- submission ----------------------------------------------------------
+
+    def routable_workers(self) -> dict[str, dict]:
+        return routable_workers(self.broker, self.stale_factor)
+
+    def submit(self, req: GenerateRequest) -> str | None:
+        """Route onto one replica's queue; returns its worker_id, or None
+        when no replica is routable (shared-queue fallback — any worker
+        that appears later serves it)."""
+        self.check_failover()
+        infos = self.routable_workers()
+        if not infos:
+            with self._lock:
+                self._counts["shared_fallback"] += 1
+            self.broker.push_request(req)
+            return None
+        wid = self._pick(req, infos)
+        self.broker.push_request_to(wid, req)
+        with self._lock:
+            self._counts["routed_total"] += 1
+            self._routed_by_worker[wid] = (
+                self._routed_by_worker.get(wid, 0) + 1
+            )
+        return wid
+
+    # -- failover ------------------------------------------------------------
+
+    def _failover_targets(self) -> list[str]:
+        """Worker ids whose work must be evacuated: registered workers
+        judged dead / stale / unhealthy that still hold routed or leased
+        requests, plus routed queues whose worker id is not registered at
+        all (the registry entry aged out). Draining workers are NOT
+        targets — they are finishing their leases and will publish
+        ``dead`` when done."""
+        depths = self.broker.routed_depths()
+        holders = self.broker.lease_holders()
+        workers = self.broker.read_workers()
+        targets = []
+        for wid, info in workers.items():
+            if not depths.get(wid) and not holders.get(wid):
+                continue
+            code, body = _worker_health(info, self.stale_factor)
+            if code == 200:
+                continue
+            if body.get("status") in (
+                STATE_DEAD, "stale-heartbeat", "unhealthy",
+                "no-heartbeat-data",
+            ):
+                targets.append(wid)
+        # Orphan routed queues only: orphan *leases* are left to the
+        # normal visibility-timeout reaper — force-expiring a lease whose
+        # holder merely never registered (a legacy worker) would
+        # double-serve its request.
+        targets.extend(
+            wid for wid in depths if wid not in workers
+        )
+        return targets
+
+    def check_failover(self, force: bool = False) -> int:
+        """Time-gated failover sweep; returns requests re-routed."""
+        now = time.monotonic()
+        with self._lock:
+            if not force and now < self._next_failover:
+                return 0
+            self._next_failover = now + self.failover_check_s
+        rerouted = 0
+        for wid in self._failover_targets():
+            for req in self.broker.failover_worker(wid):
+                infos = self.routable_workers()
+                if infos:
+                    self.broker.push_request_to(self._pick(req, infos), req)
+                else:
+                    self.broker.push_request(req)
+                rerouted += 1
+        if rerouted:
+            with self._lock:
+                self._counts["failover_reroutes"] += rerouted
+        return rerouted
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            hits = self._counts["affinity_hits"]
+            misses = self._counts["affinity_misses"]
+            total = hits + misses
+            return {
+                "policy": self.policy,
+                **self._counts,
+                "affinity_hit_rate": (hits / total) if total else None,
+                "routed_by_worker": dict(self._routed_by_worker),
+            }
+
+
+class FleetHarness:
+    """N consumers over one logical broker, entirely in-process — the CPU
+    test/bench substrate for multi-replica serving. Each replica runs
+    under a ``ChaosWorkerHost`` so a mid-decode ``HardKill`` is machine
+    death: the worker object is abandoned, its heartbeats stop, and only
+    broker-level failover/redelivery can rescue its requests.
+
+    ``make_worker(worker_id)`` builds one replica's worker (already wired
+    to a broker and registered under that id). ``respawn=False`` makes
+    every kill permanent — the shape the failover tests need.
+    """
+
+    def __init__(self, make_worker, worker_ids, *,
+                 respawn: bool = False, respawn_delay_s: float = 0.05):
+        self.hosts: dict[str, ChaosWorkerHost] = {
+            wid: ChaosWorkerHost(
+                functools.partial(make_worker, wid),
+                respawn_delay_s=respawn_delay_s, respawn=respawn,
+            )
+            for wid in worker_ids
+        }
+
+    def start(self) -> None:
+        for host in self.hosts.values():
+            host.start()
+
+    def stop(self) -> None:
+        for host in self.hosts.values():
+            host.stop()
+
+    def __enter__(self) -> "FleetHarness":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -19,6 +19,7 @@ from llmss_tpu.serve.broker import Broker
 from llmss_tpu.serve.protocol import (
     STATE_DEAD,
     STATE_DRAINING,
+    STATE_READY,
     GenerateRequest,
 )
 
@@ -76,6 +77,33 @@ def evaluate_worker_health(
     return 200, {"status": "ok", **body}, True
 
 
+def evaluate_fleet_health(
+    workers: dict, stale_factor: float = 3.0,
+) -> tuple[int, dict]:
+    """Aggregate /health over the worker registry: the fleet is healthy
+    iff at least one replica is routable (per-worker policy 200 AND
+    lifecycle ``ready``). One draining or crashed replica no longer flips
+    the whole frontend to 503 the way the single-supervisor-block logic
+    did — the survivors keep taking traffic. Per-worker detail rides
+    along for operators (same bodies as ``GET /fleet``)."""
+    per = {}
+    ready = 0
+    for wid, info in sorted(workers.items()):
+        code, body, _ = evaluate_worker_health(info, True, stale_factor)
+        routable = (
+            code == 200 and info.get("state", STATE_READY) == STATE_READY
+        )
+        ready += int(routable)
+        per[wid] = {"routable": routable, **body}
+    if ready:
+        return 200, {
+            "status": "ok", "ready": ready, "workers": per,
+        }
+    return 503, {
+        "status": "no-ready-workers", "ready": 0, "workers": per,
+    }
+
+
 class ProducerServer:
     # A worker is unhealthy after this many missed heartbeat intervals.
     HEARTBEAT_STALE_FACTOR = 3.0
@@ -85,8 +113,13 @@ class ProducerServer:
 
     def __init__(self, broker: Broker, host: str = "0.0.0.0",
                  port: int = 8000, timeout_s: float = 300.0,
-                 max_queue_depth: int = 1024):
+                 max_queue_depth: int = 1024, router=None):
         self.broker = broker
+        # Optional serve.fleet.Router: when set, /generate places each
+        # request on a replica's routed queue (policy-driven) instead of
+        # the shared queue; without one, behavior is exactly the
+        # single-worker shared-queue stack.
+        self.router = router
         self.timeout_s = timeout_s
         # Admission control: when the broker backlog reaches this depth,
         # /generate sheds with 429 + Retry-After instead of queueing work
@@ -113,11 +146,17 @@ class ProducerServer:
                 if self.path == "/health":
                     code, body = outer.health()
                     self._reply(code, body)
+                elif self.path == "/fleet":
+                    self._reply(200, outer.fleet())
                 elif self.path == "/metrics":
-                    self._reply(200, {
+                    payload = {
                         **outer.broker.read_metrics(),
                         "delivery": outer.broker.delivery_stats(),
-                    })
+                    }
+                    fleet = outer.fleet_metrics()
+                    if fleet is not None:
+                        payload["fleet"] = fleet
+                    self._reply(200, payload)
                 elif self.path == "/dlq":
                     # Admin surface for quarantined poison requests: depth
                     # plus the most recent dead-lettered payloads.
@@ -181,7 +220,7 @@ class ProducerServer:
                 import socket as _socket
                 import time as _time
 
-                outer.broker.push_request(req)
+                outer.submit(req)
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
@@ -264,7 +303,7 @@ class ProducerServer:
                 if req.stream:
                     self._stream_response(req)
                     return
-                outer.broker.push_request(req)
+                outer.submit(req)
                 resp = outer.broker.wait_response(req.id, outer.timeout_s)
                 if resp is None:
                     # The client is gone; stop the worker spending decode
@@ -280,37 +319,94 @@ class ProducerServer:
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: threading.Thread | None = None
 
+    def submit(self, req: GenerateRequest) -> None:
+        """Place an admitted request: through the router's policy when
+        one is configured, else the shared queue (pre-fleet behavior)."""
+        if self.router is not None:
+            self.router.submit(req)
+        else:
+            self.broker.push_request(req)
+
     def health(self) -> tuple[int, dict]:
-        """Worker-health-aware /health: a supervised worker publishes its
-        lifecycle state and a progress-stamped ``heartbeat_ts`` through
-        the broker metrics channel (serve/supervisor.py); draining/dead/
-        stalled workers flip this to 503 instead of serving a green light
-        over a worker that won't answer (which would otherwise pile
-        requests into 504s). Policy in ``evaluate_worker_health``."""
+        """Worker-health-aware /health. With a populated worker registry
+        the fleet aggregate applies: healthy iff >= 1 ``ready`` replica
+        (``evaluate_fleet_health``) — one draining/crashed replica no
+        longer 503s the whole frontend. With no registry (single-worker
+        deployments that never register), the original single-supervisor
+        path is used unchanged: a supervised worker publishes lifecycle
+        state and a progress-stamped ``heartbeat_ts`` through the broker
+        metrics channel, and draining/dead/stalled workers flip this to
+        503. Policy in ``evaluate_worker_health``."""
+        workers = self.broker.read_workers()
+        if workers:
+            return evaluate_fleet_health(
+                workers, self.HEARTBEAT_STALE_FACTOR,
+            )
         sup = self.broker.read_metrics().get("supervisor")
         code, body, self._saw_supervisor = evaluate_worker_health(
             sup, self._saw_supervisor, self.HEARTBEAT_STALE_FACTOR,
         )
         return code, body
 
+    def fleet(self) -> dict:
+        """GET /fleet: per-worker registry detail + routed queue depths +
+        router stats."""
+        from llmss_tpu.serve.fleet import fleet_status
+
+        return fleet_status(
+            self.broker, self.router, self.HEARTBEAT_STALE_FACTOR,
+        )
+
+    def fleet_metrics(self) -> dict | None:
+        """Fleet block for GET /metrics: per-worker load/queue-depth
+        labels plus routing counters (routed per policy/worker, failover
+        re-routes, prefix-affinity hit rate). None when no fleet exists —
+        the pre-fleet /metrics payload stays byte-identical."""
+        workers = self.broker.read_workers()
+        if not workers and self.router is None:
+            return None
+        keys = (
+            "state", "inflight_rows", "queue_depth", "free_kv_blocks",
+            "free_slots", "kv_blocks_total",
+        )
+        out: dict = {
+            "workers": {
+                wid: {k: info.get(k) for k in keys}
+                for wid, info in sorted(workers.items())
+            },
+            "routed_depths": self.broker.routed_depths(),
+        }
+        if self.router is not None:
+            out["router"] = self.router.stats()
+        return out
+
     def worker_unavailable(self) -> str | None:
-        """``'draining'`` / ``'dead'`` when the published worker lifecycle
-        says new work must be shed, else None. Memoized for
-        ``STATE_MEMO_S`` so per-request admission doesn't pay a broker
-        metrics read. (One metrics channel — with a multi-worker fleet
-        behind one broker the last publisher wins, so a drain sheds
-        front-door traffic fleet-wide; per-worker health channels are
-        future work.)"""
+        """A shed reason when the published worker state says new work
+        must not be admitted, else None. Memoized for ``STATE_MEMO_S`` so
+        per-request admission doesn't pay a broker read. With a populated
+        registry this is the fleet aggregate (shed only when NO replica
+        is routable); otherwise the legacy single-supervisor-block logic
+        (draining/dead sheds fleet-wide, since one metrics channel is all
+        there is)."""
         import time as _time
 
         now = _time.monotonic()
         if now < self._state_memo_until:
             return self._state_memo
-        sup = self.broker.read_metrics().get("supervisor")
-        state = sup.get("state") if isinstance(sup, dict) else None
-        self._state_memo = (
-            state if state in (STATE_DRAINING, STATE_DEAD) else None
-        )
+        workers = self.broker.read_workers()
+        if workers:
+            code, _body = evaluate_fleet_health(
+                workers, self.HEARTBEAT_STALE_FACTOR,
+            )
+            self._state_memo = (
+                None if code == 200 else "unavailable (no ready replica)"
+            )
+        else:
+            sup = self.broker.read_metrics().get("supervisor")
+            state = sup.get("state") if isinstance(sup, dict) else None
+            self._state_memo = (
+                state if state in (STATE_DRAINING, STATE_DEAD) else None
+            )
         self._state_memo_until = now + self.STATE_MEMO_S
         return self._state_memo
 
@@ -333,14 +429,15 @@ class ProducerServer:
 
 
 def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
-                       max_queue_depth: int = 1024):
+                       max_queue_depth: int = 1024, router=None):
     """FastAPI variant of the producer (optional dependency, gated).
 
     Full API parity with ``ProducerServer``: POST /generate (JSON or SSE
     streaming via ``stream: true``, same event format, 429 + Retry-After
-    admission control, lifecycle-aware 503 shedding, deadline stamping),
-    POST /cancel, GET /metrics, GET /health (worker-health-aware),
-    GET /dlq."""
+    admission control, lifecycle-aware 503 shedding, deadline stamping,
+    policy routing when a ``router`` is given), POST /cancel,
+    GET /metrics, GET /health (fleet-aggregate when a worker registry is
+    populated), GET /fleet, GET /dlq."""
     import time as _time
 
     from fastapi import FastAPI, HTTPException
@@ -349,15 +446,30 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
     app = FastAPI()
     hstate = {"saw_supervisor": False, "memo": None, "memo_until": 0.0}
 
+    def _submit(req: GenerateRequest) -> None:
+        if router is not None:
+            router.submit(req)
+        else:
+            broker.push_request(req)
+
     def _worker_unavailable() -> str | None:
         now = _time.monotonic()
         if now < hstate["memo_until"]:
             return hstate["memo"]
-        sup = broker.read_metrics().get("supervisor")
-        state = sup.get("state") if isinstance(sup, dict) else None
-        hstate["memo"] = (
-            state if state in (STATE_DRAINING, STATE_DEAD) else None
-        )
+        workers = broker.read_workers()
+        if workers:
+            code, _body = evaluate_fleet_health(
+                workers, ProducerServer.HEARTBEAT_STALE_FACTOR,
+            )
+            hstate["memo"] = (
+                None if code == 200 else "unavailable (no ready replica)"
+            )
+        else:
+            sup = broker.read_metrics().get("supervisor")
+            state = sup.get("state") if isinstance(sup, dict) else None
+            hstate["memo"] = (
+                state if state in (STATE_DRAINING, STATE_DEAD) else None
+            )
         hstate["memo_until"] = now + ProducerServer.STATE_MEMO_S
         return hstate["memo"]
 
@@ -418,7 +530,7 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
             )
         if req.deadline_ts is None:
             req.deadline_ts = _time.time() + timeout_s
-        broker.push_request(req)
+        _submit(req)
         if req.stream:
             return StreamingResponse(
                 _sse(req), media_type="text/event-stream",
@@ -442,10 +554,35 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
 
     @app.get("/metrics")
     def metrics():
-        return {
+        payload = {
             **broker.read_metrics(),
             "delivery": broker.delivery_stats(),
         }
+        workers = broker.read_workers()
+        if workers or router is not None:
+            keys = (
+                "state", "inflight_rows", "queue_depth", "free_kv_blocks",
+                "free_slots", "kv_blocks_total",
+            )
+            fleet: dict = {
+                "workers": {
+                    wid: {k: info.get(k) for k in keys}
+                    for wid, info in sorted(workers.items())
+                },
+                "routed_depths": broker.routed_depths(),
+            }
+            if router is not None:
+                fleet["router"] = router.stats()
+            payload["fleet"] = fleet
+        return payload
+
+    @app.get("/fleet")
+    def fleet():
+        from llmss_tpu.serve.fleet import fleet_status
+
+        return fleet_status(
+            broker, router, ProducerServer.HEARTBEAT_STALE_FACTOR,
+        )
 
     @app.get("/dlq")
     def dlq():
@@ -456,6 +593,12 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
 
     @app.get("/health")
     def health():
+        workers = broker.read_workers()
+        if workers:
+            code, body = evaluate_fleet_health(
+                workers, ProducerServer.HEARTBEAT_STALE_FACTOR,
+            )
+            return JSONResponse(status_code=code, content=body)
         sup = broker.read_metrics().get("supervisor")
         code, body, hstate["saw_supervisor"] = evaluate_worker_health(
             sup, hstate["saw_supervisor"],
@@ -480,14 +623,27 @@ def main(argv=None):
     parser.add_argument("--max_queue_depth", type=int, default=1024,
                         help="shed with 429 once the broker backlog reaches "
                              "this depth (0 disables)")
+    parser.add_argument("--policy", default=None,
+                        choices=[None, "round_robin", "least_loaded",
+                                 "prefix_affinity"],
+                        help="fleet routing policy: place requests on "
+                             "per-worker routed queues via the worker "
+                             "registry (workers must run with --worker_id); "
+                             "omit for the shared queue")
     args = parser.parse_args(argv)
 
     from llmss_tpu.serve.broker import RedisBroker
 
     broker = RedisBroker(args.redis_host, args.redis_port)
+    router = None
+    if args.policy:
+        from llmss_tpu.serve.fleet import Router
+
+        router = Router(broker, args.policy)
     server = ProducerServer(broker, args.host, args.port,
                             timeout_s=args.timeout_s,
-                            max_queue_depth=args.max_queue_depth)
+                            max_queue_depth=args.max_queue_depth,
+                            router=router)
     print(f"producer listening on {args.host}:{server.port}")
     server.serve_forever()
 

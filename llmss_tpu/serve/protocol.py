@@ -10,6 +10,7 @@ outputs.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import uuid
 
@@ -29,6 +30,21 @@ STATE_READY = "ready"
 STATE_DRAINING = "draining"
 STATE_DEAD = "dead"
 WORKER_STATES = (STATE_STARTING, STATE_READY, STATE_DRAINING, STATE_DEAD)
+
+
+def prefix_hash(token_ids) -> str:
+    """Stable identity for a shared prompt prefix (system prompt / session
+    head), used as the routing key by the fleet's ``prefix_affinity``
+    policy and as the resident-prefix label in scheduler load snapshots.
+
+    Content-addressed (SHA-1 over the token ids, truncated) rather than
+    object identity: the router and N workers each compute it
+    independently from the token list and must agree across processes.
+    """
+    h = hashlib.sha1()
+    for t in token_ids:
+        h.update(int(t).to_bytes(4, "little", signed=True))
+    return h.hexdigest()[:16]
 
 
 @dataclasses.dataclass

@@ -160,6 +160,42 @@ class Supervisor:
             self.broker.publish_metrics(metrics)
         except Exception:  # noqa: BLE001 — broker down ≠ worker down
             logger.warning("metrics publish failed", exc_info=True)
+        self._publish_worker_load(worker)
+
+    def _publish_worker_load(self, worker) -> None:
+        """Fleet registry heartbeat for a worker with a fleet identity:
+        the worker's own load snapshot with the supervisor's lifecycle
+        view stamped over it — the supervisor knows about states the
+        worker can't see from inside (starting, crash-backoff, dead), and
+        its heartbeat_ts is the progress-based one the watchdog trusts.
+        The terminal publish in ``run``'s finally (state ``dead``) is what
+        lets routers fail the worker over promptly instead of waiting out
+        the staleness window."""
+        wid = getattr(worker, "worker_id", None)
+        if wid is None:
+            return
+        snap_fn = getattr(worker, "load_snapshot", None)
+        snap = {}
+        if snap_fn is not None:
+            try:
+                snap = snap_fn()
+            except Exception:  # noqa: BLE001 — heartbeat must not crash loop
+                logger.warning("load snapshot failed", exc_info=True)
+        status = self._status()
+        snap.update({
+            "state": self.state,
+            "alive": status["alive"],
+            "restarts": status["restarts"],
+            "heartbeat_ts": status["heartbeat_ts"],
+            "heartbeat_s": min(
+                self.heartbeat_s,
+                float(snap.get("heartbeat_s") or self.heartbeat_s),
+            ),
+        })
+        try:
+            self.broker.publish_worker_load(wid, snap)
+        except Exception:  # noqa: BLE001 — broker down ≠ worker down
+            logger.warning("worker load publish failed", exc_info=True)
 
     def _abort_inflight(self, worker, reason: str) -> None:
         """Error out every request the dying worker still holds — a client
