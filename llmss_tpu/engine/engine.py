@@ -200,6 +200,15 @@ class DecodeEngine:
             donate_argnums=(2,),
             static_argnames=("n_steps", "t_bucket"),
         )
+        # Grouped decode: n_chunks fused chunks in ONE program with ONE
+        # packed device→host fetch for the whole group. Donates the token
+        # and position carries as well as the cache — XLA reuses their
+        # storage across every step of the group.
+        self._decode_group = jax.jit(
+            partial(self._decode_group_impl, cfg, mesh),
+            donate_argnums=(1, 2, 3),
+            static_argnames=("n_chunks", "n_steps", "t_bucket"),
+        )
         self._admit_merge = jax.jit(
             self._admit_merge_impl, donate_argnums=(0, 1)
         )
@@ -420,35 +429,10 @@ class DecodeEngine:
         A poisoned row is forced done on device — its later "tokens" are
         EOS fills — and the host errors out exactly that row; co-batched
         rows never see it (row isolation is positional)."""
-        from llmss_tpu.models.decoder import forward
-
-        def body(carry, _):
-            tokens, cache, cur_pos, done, poisoned = carry
-            positions = cur_pos[:, None]
-            # Done rows stop WRITING KV: their slot goes positive-OOB, and
-            # every write site drops OOB indices. A dense done-row write
-            # was merely wasted bandwidth (the row owns its ring); under
-            # the paged layout a freed row's STALE device block table may
-            # point at blocks the allocator already handed to another row
-            # — or at shared prefix blocks, once its position wraps — so
-            # the write must not land at all (docs/paged-kv.md).
-            slots = jnp.where(
-                done[:, None], cache.max_len, positions % cache.max_len
-            )
-            logits, cache = forward(
-                cfg, params, tokens[:, None], positions, cache, slots,
-                last_only=True, mesh=mesh, t_bucket=t_bucket,
-            )
-            from llmss_tpu.ops.sampling import nonfinite_rows
-
-            bad = nonfinite_rows(logits[:, 0]) & ~done
-            poisoned = poisoned | bad
-            tok = sample(logits[:, 0], counters=cur_pos + 1, **sample_args)
-            tok = jnp.where(done | bad, eos, tok)
-            done = done | bad | (tok == eos)
-            cur_pos = cur_pos + 1
-            return (tok, cache, cur_pos, done, poisoned), tok
-
+        body = partial(
+            DecodeEngine._decode_step_body, cfg, mesh, params, sample_args,
+            eos, t_bucket,
+        )
         poisoned0 = jnp.zeros_like(done)
         carry, toks = jax.lax.scan(
             body, (tokens, cache, cur_pos, done, poisoned0), None,
@@ -456,6 +440,97 @@ class DecodeEngine:
         )
         tokens, cache, cur_pos, done, poisoned = carry
         return toks.T, cache, cur_pos, done, poisoned  # toks [B, n_steps]
+
+    @staticmethod
+    def _decode_step_body(cfg, mesh, params, sample_args, eos, t_bucket,
+                          carry, _x=None):
+        """One fused decode step — the scanned body shared by
+        ``_decode_many`` and the grouped ``_decode_group`` (the two paths
+        are bit-identical by construction because this IS the same
+        traced program)."""
+        from llmss_tpu.models.decoder import forward
+        from llmss_tpu.ops.sampling import fold_step_outcome
+
+        tokens, cache, cur_pos, done, poisoned = carry
+        positions = cur_pos[:, None]
+        # Done rows stop WRITING KV: their slot goes positive-OOB, and
+        # every write site drops OOB indices. A dense done-row write
+        # was merely wasted bandwidth (the row owns its ring); under
+        # the paged layout a freed row's STALE device block table may
+        # point at blocks the allocator already handed to another row
+        # — or at shared prefix blocks, once its position wraps — so
+        # the write must not land at all (docs/paged-kv.md).
+        slots = jnp.where(
+            done[:, None], cache.max_len, positions % cache.max_len
+        )
+        logits, cache = forward(
+            cfg, params, tokens[:, None], positions, cache, slots,
+            last_only=True, mesh=mesh, t_bucket=t_bucket,
+        )
+        tok = sample(logits[:, 0], counters=cur_pos + 1, **sample_args)
+        tok, done, poisoned = fold_step_outcome(
+            logits[:, 0], tok, done, poisoned, eos
+        )
+        cur_pos = cur_pos + 1
+        return (tok, cache, cur_pos, done, poisoned), tok
+
+    @staticmethod
+    def _decode_group_impl(
+        cfg, mesh, params, tokens, cache, cur_pos, sample_args, done,
+        eos, *, n_chunks: int, n_steps: int, t_bucket: int | None = None,
+    ):
+        """A GROUP of ``n_chunks`` fused decode chunks as one program: an
+        outer ``lax.scan`` over the ``_decode_many`` chunk scan, with EOS/
+        done and poison folded into the on-device carry so no host decision
+        is needed between chunks. The host gets everything in ONE packed
+        int32 transfer — ``n_chunks·B·n_steps`` tokens followed by
+        ``n_chunks·B`` per-chunk poisoned flags (cumulative within the
+        group, snapshotted after each chunk so the host can error a
+        poisoned row at the same chunk granularity as the ungrouped
+        path) — instead of one tokens + one poisoned fetch per chunk.
+
+        Returns ``(packed [n_chunks·B·(n_steps+1)] int32, last_tok [B],
+        cache, cur_pos, done)``; the carried token/position/cache outputs
+        feed the next group's dispatch directly (device-resident state,
+        donated in)."""
+        body = partial(
+            DecodeEngine._decode_step_body, cfg, mesh, params, sample_args,
+            eos, t_bucket,
+        )
+        # The stacked ys MUST be pinned to a replicated sharding here:
+        # GSPMD otherwise propagates an unreduced partial-sum layout from
+        # the tp-sharded logits into the outer scan's stacked output, and
+        # the host reads token values summed over the tp axis (observed:
+        # every packed token exactly tp× its true value). The carry never
+        # hits this — its sharding is pinned by the next iteration's
+        # consumers — only the ys leave the loop unconstrained.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = (
+            NamedSharding(mesh, PartitionSpec()) if mesh is not None
+            else None
+        )
+        pin = (
+            (lambda x: jax.lax.with_sharding_constraint(x, rep))
+            if rep is not None else (lambda x: x)
+        )
+
+        def chunk(carry, _):
+            carry, toks = jax.lax.scan(body, carry, None, length=n_steps)
+            # Snapshot per-chunk: toks [n_steps, B] → [B, n_steps]; the
+            # poison flags as of this chunk's end.
+            return carry, (pin(toks.T), pin(carry[4]))
+
+        poisoned0 = jnp.zeros_like(done)
+        carry, (toks, pois) = jax.lax.scan(
+            chunk, (tokens, cache, cur_pos, done, poisoned0), None,
+            length=n_chunks,
+        )
+        tokens, cache, cur_pos, done, _ = carry
+        packed = jnp.concatenate(
+            [toks.reshape(-1), pois.astype(jnp.int32).reshape(-1)]
+        )
+        return packed, tokens, cache, cur_pos, done
 
     # -- host API -----------------------------------------------------------
 
@@ -606,11 +681,16 @@ class DecodeEngine:
             done = self.canon_vec(jnp.zeros(batch, bool))
             eos = self.canon_vec(jnp.full(batch, -1, jnp.int32))
             for tb in bucket_set:
-                _, c2, _, _, _ = self._decode_many(
+                # generate()'s chunked branch runs the grouped program at
+                # n_chunks=1 — token/position carries are donated, so
+                # rebind them from the outputs before the next compile.
+                _, t2, c2, cur2, _ = self._decode_group(
                     self.params, tok, cache, cur, sa, done, eos,
-                    n_steps=k, t_bucket=tb,
+                    n_chunks=1, n_steps=k, t_bucket=tb,
                 )
                 cache = self.canon_cache(c2)
+                tok = self.canon_vec(t2)
+                cur = self.canon_vec(cur2)
                 n += 1
         # Drain the device before returning: each prewarm call above also
         # DISPATCHED one execution, and on remote-tunnel backends the
@@ -836,6 +916,18 @@ class DecodeEngine:
                     f"max_seq_len {self.max_seq_len}"
                 )
             ids, suf_lens = self._pad_prompts(suffixes)
+            if prefix.length + ids.shape[1] > self.max_seq_len:
+                # The suffix prefill pads to a BUCKET, and every padded
+                # column computes a slot (slot = position % max_len) even
+                # though its kv position is masked to -1 — so a start +
+                # bucket reaching past the ring wraps those writes over
+                # the just-seeded prefix slots, destroying the reused KV.
+                # The request itself fits (checked above); only the
+                # bucket-padded suffix doesn't. Fall back to a from-scratch
+                # prefill of the full prompts — identical tokens, just
+                # without the prefix's FLOP savings.
+                prefix = None
+        if prefix is not None:
             cache = self.canon_cache(self.seed_cache(cache, prefix))
             start = jnp.full(B, prefix.length, jnp.int32)
             tok, _, cache = self.timed_prefill(
@@ -936,23 +1028,30 @@ class DecodeEngine:
                 flush_increments()
             else:
                 t0 = time.perf_counter()
-                toks, cache, cur_pos, _, poisoned = self._decode_many(
+                packed, last_tok, cache, cur_pos, _ = self._decode_group(
                     self.params, tok, cache, cur_pos, sample_args,
-                    self.canon_vec(jnp.asarray(done)), eos_dev, n_steps=k,
+                    self.canon_vec(jnp.asarray(done)), eos_dev,
+                    n_chunks=1, n_steps=k,
                     t_bucket=self.decode_bucket(pos_hi + k),
                 )
                 cache = self.canon_cache(cache)
                 cur_pos = self.canon_vec(cur_pos)
+                tok = self.canon_vec(last_tok)
                 pos_hi += k
-                # One fetch per k-step chunk BY DESIGN: this single sync
-                # amortizes host-link latency over the whole chunk (the
+                self.metrics.host_dispatch.record(time.perf_counter() - t0)
+                self.metrics.add_group()
+                # ONE packed fetch per chunk BY DESIGN: tokens and poison
+                # flags cross the host link in a single transfer (the
                 # pipelined scheduler overlaps it with the next dispatch).
-                chunk_np = np.asarray(toks)  # lint: ignore[host-sync-in-loop]
-                poisoned_np = np.asarray(poisoned)  # lint: ignore[host-sync-in-loop]
+                with self.metrics.host_fetch.time():
+                    flat = np.asarray(packed)  # lint: ignore[host-sync-in-loop]
+                self.metrics.add_host_sync()
+                chunk_np = flat[: B * k].reshape(B, k)
+                poisoned_np = flat[B * k:].astype(bool)
                 self.metrics.decode_step.record(
                     (time.perf_counter() - t0) / k
                 )
-                tok = self.canon_vec(toks[:, -1])
+                t_cb = time.perf_counter()
                 for col in range(k):
                     if process(chunk_np[:, col]):
                         break
@@ -967,6 +1066,9 @@ class DecodeEngine:
                     for i in np.flatnonzero(poisoned_np):
                         on_poisoned(int(i))
                 flush_increments()
+                self.metrics.host_callback.record(
+                    time.perf_counter() - t_cb
+                )
         self.metrics.add_tokens(
             sum(len(o) for o in out[: live_rows or B])
         )
@@ -1010,15 +1112,16 @@ class DecodeEngine:
         )
         eos_dev = self.canon_vec(jnp.full(B, int(eos), jnp.int32))
         done = self.canon_vec(tok == eos_dev)
-        toks, cache, _, done, _ = self._decode_many(
-            self.params, tok, cache, self.canon_vec(jnp.asarray(lens)),
-            sample_args, done, eos_dev, n_steps=gen.max_new_tokens - 1,
-            t_bucket=self.decode_bucket(
-                int(lens.max()) + gen.max_new_tokens - 1
-            ),
-        )
+        # Read the prefill token BEFORE the grouped call: the token carry
+        # is donated, so the buffer is dead once the program is enqueued.
         first = np.asarray(tok)[:, None]
-        rest = np.asarray(toks)
+        n_steps = gen.max_new_tokens - 1
+        packed, _, cache, _, done = self._decode_group(
+            self.params, tok, cache, self.canon_vec(jnp.asarray(lens)),
+            sample_args, done, eos_dev, n_chunks=1, n_steps=n_steps,
+            t_bucket=self.decode_bucket(int(lens.max()) + n_steps),
+        )
+        rest = np.asarray(packed)[: B * n_steps].reshape(B, n_steps)
         all_toks = np.concatenate([first, rest], axis=1)
         out = []
         for row in all_toks:

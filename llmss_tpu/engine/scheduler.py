@@ -9,28 +9,35 @@ cache and inserted into free rows between decode chunks; every chunk advances
 all active rows with per-row sampling parameters; finished rows free for the
 next waiting request — no request waits for an unrelated request to finish.
 
-**The decode state lives on device and the host observes it one chunk late.**
+**The decode state lives on device and the host observes it one GROUP late.**
 Round 3 fetched every chunk's tokens before dispatching the next chunk, so
 each chunk paid a full device→host round-trip on the critical path (~90 ms on
 the axon bench host — the serving layer reached 0.21 of roofline while the
 bare engine hit 0.65). Here:
 
-- ``tokens``/``cur_pos`` are device arrays; the fused decode chunk feeds
-  itself, so chunk N+1 is dispatched *before* chunk N's tokens are fetched
+- ``tokens``/``cur_pos`` are device arrays; the fused decode group feeds
+  itself, so group N+1 is dispatched *before* group N's results are fetched
   and the fetch overlaps device compute instead of serializing behind it.
+- While busy, ``group_chunks`` fused chunks run as ONE jitted program
+  (``DecodeEngine._decode_group``): EOS/done and poison flags carry on
+  device between the chunks, and the whole group's tokens + per-chunk
+  poison flags cross the host link in a single packed int32 transfer —
+  host syncs and dispatch overhead scale per group, not per chunk
+  (docs/decode-loop.md).
 - Admissions merge their first tokens into the device state with a jitted
   scatter (``DecodeEngine._admit_merge``) — the host never needs to see a
   token to keep the device advancing.
-- The host processes chunk N's results (stream callbacks, EOS/max-token
-  finishes, row frees) while chunk N+1 runs. Freeing and admission therefore
-  lag one chunk — a freshly finished row keeps decoding discarded fills for
-  one extra chunk, the same cost an idle row pays anyway.
+- The host processes group N's results (stream callbacks, EOS/max-token
+  finishes, row frees) while group N+1 runs. Freeing and admission therefore
+  lag one group — a freshly finished row keeps decoding discarded fills for
+  one extra group, the same cost an idle row pays anyway.
 
 Invariant tested in ``tests/test_continuous.py``: interleaved admission must
 produce exactly the tokens the request would get alone (row isolation — the
 causal mask is driven by per-row cache positions, so rows never see each
-other; the one-chunk lag changes *when* the host learns tokens, never which
-tokens the device computes).
+other; the one-group lag changes *when* the host learns tokens, never which
+tokens the device computes), and grouped dispatch must emit bit-identical
+token streams to the ungrouped path under EOS, poison, and admission churn.
 """
 
 from __future__ import annotations
@@ -85,26 +92,28 @@ class _InFlightAdmission:
 
 
 @dataclasses.dataclass
-class _InFlightChunk:
-    """A dispatched decode chunk whose tokens the host hasn't read yet."""
+class _InFlightGroup:
+    """A dispatched decode GROUP (n_chunks fused chunks in one jitted
+    program) whose packed results the host hasn't read yet."""
 
-    toks: jax.Array  # [rows, k] (device; copy_to_host_async issued)
-    k: int
+    # Flat int32 device array (copy_to_host_async issued):
+    # ``n_chunks·rows·k`` tokens followed by ``n_chunks·rows`` per-chunk
+    # poisoned flags — the group's ONE device→host transfer. Poisoned rows
+    # were already forced done on device (EOS fills from the bad step on);
+    # _process_group errors them out instead of reporting a success.
+    packed: jax.Array
+    n_chunks: int
+    k: int  # steps per chunk
     # An admission's device work (prefill+insert+merge) ran between the
-    # previous chunk and this one, so this chunk's fetch-to-fetch interval
+    # previous group and this one, so this group's fetch-to-fetch interval
     # is not a clean decode-only sample.
     has_admission: bool = False
-    # [rows] bool (device): rows whose logits went non-finite during this
-    # chunk (ops.sampling.nonfinite_rows inside the fused scan). The device
-    # already forced these rows done (EOS fills from the bad step on);
-    # _process_chunk errors them out instead of reporting a success.
-    poisoned: jax.Array | None = None
 
 
 class ContinuousBatcher:
     def __init__(
         self, engine: DecodeEngine, *, rows: int = 8, chunk_steps: int = 1,
-        chunk_steps_low: int | None = None,
+        chunk_steps_low: int | None = None, group_chunks: int = 1,
     ):
         # chunk_steps > 1 advances all rows that many tokens per scheduler
         # step (one fused scan instead of per-token dispatch); combined
@@ -118,8 +127,21 @@ class ContinuousBatcher:
         # chip has headroom and the shorter chunk halves perceived TTFT;
         # at saturation the full chunk keeps the host off the critical
         # path. Both sizes are prewarmed.
+        #
+        # ``group_chunks`` (K) dispatches K chunks as ONE jitted program
+        # while busy (DecodeEngine._decode_group): on-device EOS/poison
+        # carry between the chunks and the host gets one packed fetch per
+        # GROUP — K× fewer host syncs and dispatches at saturation, at the
+        # cost of admission/free granularity stretching to K chunks. At
+        # low load the group collapses to (1 × chunk_steps_low) so TTFT
+        # keeps the short-chunk latency. Token streams are bit-identical
+        # to group_chunks=1 (docs/decode-loop.md).
         if chunk_steps < 1:
             raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
+        if group_chunks < 1:
+            raise ValueError(
+                f"group_chunks must be >= 1, got {group_chunks}"
+            )
         self.engine = engine
         self.rows = rows
         self.chunk_steps = chunk_steps
@@ -127,6 +149,7 @@ class ContinuousBatcher:
             chunk_steps_low if chunk_steps_low is not None
             else max(1, chunk_steps // 2)
         )
+        self.group_chunks = group_chunks
         # Paged KV: the scheduling capacity unit becomes the block pool,
         # not the row count — rows are admitted when free blocks cover
         # prompt + max_new (+ shared prefix blocks ride for free), and a
@@ -178,7 +201,7 @@ class ContinuousBatcher:
         self._cur_pos_dev = engine.canon_vec(jnp.zeros(rows, jnp.int32))
         self._step_count = 0
         self._cancelled: set[str] = set()  # guarded_by: self._lock
-        self._inflight: _InFlightChunk | None = None
+        self._inflight: _InFlightGroup | None = None
         self._pending_adm: _InFlightAdmission | None = None
         self._last_fetch_t: float | None = None
         self._lock = threading.Lock()
@@ -517,22 +540,27 @@ class ContinuousBatcher:
                 )
             )
             n_compiled += 1
-        # Decode chunk at the full row count: both chunk sizes × every
-        # cache-read bucket (the live path picks the bucket from row
-        # positions, so all ladder entries are reachable).
+        # Decode group at the full row count: both live (n_chunks, k)
+        # combos — the busy full group and the low-load single short chunk
+        # — × every cache-read bucket (the live path picks the bucket from
+        # row positions, so all ladder entries are reachable).
         sa = eng._sample_args(GenerationParams(), self.rows)
-        for k in sorted({self.chunk_steps, self.chunk_steps_low}):
+        combos = sorted({
+            (self.group_chunks, self.chunk_steps),
+            (1, self.chunk_steps_low),
+        })
+        for nc, k in combos:
             for tb in eng.prewarm_bucket_set():
-                toks, cache, cur_pos, _, _ = eng._decode_many(
+                _, last_tok, cache, cur_pos, _ = eng._decode_group(
                     eng.params, self._tokens_dev, self.cache,
                     self._cur_pos_dev, sa,
                     jnp.ones(self.rows, bool),
                     jnp.full(self.rows, -1, np.int32),
-                    n_steps=k, t_bucket=tb,
+                    n_chunks=nc, n_steps=k, t_bucket=tb,
                 )
                 self.cache = eng.canon_cache(cache)
                 self._cur_pos_dev = eng.canon_vec(cur_pos)
-                self._tokens_dev = eng.canon_vec(toks[:, -1])
+                self._tokens_dev = eng.canon_vec(last_tok)
                 n_compiled += 1
         # The prewarm decode ran with every row marked done/free, but its
         # cache writes still landed — reset positions so no ghost slots
@@ -582,6 +610,17 @@ class ContinuousBatcher:
                     "token_ids does not extend the prefix (needs its "
                     f"{P} tokens plus at least one more)"
                 )
+            if P + _bucket(
+                len(token_ids) - P, self.engine.max_seq_len
+            ) > self.engine.max_seq_len:
+                # Ring-wrap guard (ADVICE.md high): even this request's
+                # own BUCKET-padded suffix would reach past the ring and
+                # wrap over the seeded prefix slots — admit it without the
+                # prefix (from-scratch prefill, identical tokens). Dropping
+                # here also keeps it out of the prefix's admission group,
+                # where a longer batchmate's bucket applies the same guard
+                # batch-wide (_admit_dispatch).
+                prefix = None
         # With chunked decode a near-capacity row would advance past
         # max_seq_len mid-chunk, wrap, and silently serve context-corrupted
         # tokens (the host can't see the wrap — the decode state is
@@ -635,6 +674,24 @@ class ContinuousBatcher:
             self.pending = rest
             rows = [self._free.pop() for _ in taken]
             n = len(taken)
+
+        if head_prefix is not None:
+            # Ring-wrap guard (ADVICE.md high): the suffix prefill pads to
+            # the BATCH's bucket, and padded columns still compute slots
+            # (slot = position % max_len) — a prefix start + bucket past
+            # the ring would wrap those writes over the seeded prefix
+            # slots. Decided BEFORE the paged reserve so the block
+            # accounting matches the prefill actually dispatched. The batch
+            # admits WITHOUT the prefix (from-scratch prefill of the full
+            # prompts — always ring-safe since _bucket caps at
+            # max_seq_len); identical tokens, only the prefix's FLOP
+            # savings are lost.
+            probe = _bucket(
+                max(len(item[1]) - head_prefix.length for item in taken),
+                self.engine.max_seq_len,
+            )
+            if head_prefix.length + probe > self.engine.max_seq_len:
+                head_prefix = None
 
         if self._paged:
             # Second gate: row slots are necessary but not sufficient —
@@ -941,91 +998,107 @@ class ContinuousBatcher:
         sa = self.engine._sample_args(gens, self.rows)
         return done, eos_arr, sa
 
-    def _process_chunk(self, chunk: _InFlightChunk) -> int:
-        """Fetch a chunk's tokens (overlapped with the next chunk already
-        running on device) and apply host bookkeeping: per-row token
-        accounting, stream flushes, EOS / max-token finishes."""
-        toks_np = np.asarray(chunk.toks)  # [rows, k] — the blocking fetch
-        poisoned_np = (
-            np.asarray(chunk.poisoned) if chunk.poisoned is not None
-            else np.zeros(self.rows, bool)
-        )
+    def _process_group(self, group: _InFlightGroup) -> int:
+        """Fetch a group's packed results (ONE device→host transfer,
+        overlapped with the next group already running on device) and
+        apply host bookkeeping chunk by chunk: per-row token accounting,
+        stream flushes, EOS / max-token finishes — the same per-chunk
+        granularity as the ungrouped path, so a row that finishes (or
+        poisons) in chunk c never has chunk c+1's fill tokens read as
+        output."""
+        R, k, nc = self.rows, group.k, group.n_chunks
+        with self.engine.metrics.host_fetch.time():
+            flat = np.asarray(group.packed)  # the ONE blocking fetch
+        self.engine.metrics.add_host_sync()
+        toks_np = flat[: nc * R * k].reshape(nc, R, k)
+        poisoned_np = flat[nc * R * k:].reshape(nc, R).astype(bool)
         now = time.perf_counter()
-        if self._last_fetch_t is not None and not chunk.has_admission:
-            # Fetch-to-fetch interval — but only for chunks with no
+        if self._last_fetch_t is not None and not group.has_admission:
+            # Fetch-to-fetch interval — but only for groups with no
             # admission dispatched in between: the admission's prefill +
-            # insert + merge execute on device between the two chunks and
+            # insert + merge execute on device between the two groups and
             # would inflate the per-token decode stat.
             self.engine.metrics.decode_step.record(
-                (now - self._last_fetch_t) / chunk.k
+                (now - self._last_fetch_t) / (nc * k)
             )
         self._last_fetch_t = now
 
         n = 0
-        for i in list(self.active):
-            r = self.active[i]
-            if r.awaiting_first:
-                continue  # admitted after this chunk was dispatched
-            if poisoned_np[i]:
-                # Checked BEFORE token processing: the device EOS-filled the
-                # poisoned row from the bad step on (with -1 when the row
-                # has no eos), so its chunk tokens would otherwise read as a
-                # clean early finish. Error the row with the tokens produced
-                # before the poison; co-batched rows are untouched (row
-                # isolation is positional — a NaN never crosses rows).
-                self.engine.metrics.add_poisoned(1)
-                self._finish(
-                    i, r,
-                    error="non-finite logits: row poisoned "
-                          "(NaN/inf in model output)",
+        t_cb = time.perf_counter()
+        for c in range(nc):
+            for i in list(self.active):
+                r = self.active[i]
+                if r.awaiting_first:
+                    continue  # admitted after this group was dispatched
+                if poisoned_np[c, i]:
+                    # Checked BEFORE token processing: the device
+                    # EOS-filled the poisoned row from the bad step on
+                    # (with -1 when the row has no eos), so its chunk
+                    # tokens would otherwise read as a clean early finish.
+                    # Error the row with the tokens produced before the
+                    # poison; co-batched rows are untouched (row isolation
+                    # is positional — a NaN never crosses rows). The flags
+                    # are cumulative within the group, so the row errors at
+                    # its FIRST poisoned chunk and leaves ``active``.
+                    self.engine.metrics.add_poisoned(1)
+                    self._finish(
+                        i, r,
+                        error="non-finite logits: row poisoned "
+                              "(NaN/inf in model output)",
+                    )
+                    continue
+                eos = (
+                    r.gen.eos_token_id
+                    if r.gen.eos_token_id is not None else -1
                 )
-                continue
-            eos = r.gen.eos_token_id if r.gen.eos_token_id is not None else -1
-            finished = False
-            for col in range(chunk.k):
-                t = int(toks_np[i, col])
-                if t == eos:
-                    finished = True
-                    break
-                r.out.append(t)
-                n += 1
-                if len(r.out) >= r.gen.max_new_tokens:
-                    finished = True
-                    break
-            if finished:
-                self._finish(i, r)
-            else:
-                self._flush_stream(r)
+                finished = False
+                for col in range(k):
+                    t = int(toks_np[c, i, col])
+                    if t == eos:
+                        finished = True
+                        break
+                    r.out.append(t)
+                    n += 1
+                    if len(r.out) >= r.gen.max_new_tokens:
+                        finished = True
+                        break
+                if finished:
+                    self._finish(i, r)
+                else:
+                    self._flush_stream(r)
         self.engine.metrics.add_tokens(n)
+        self.engine.metrics.host_callback.record(time.perf_counter() - t_cb)
         return n
 
     def step(self) -> int:
         """One scheduler iteration of the pipelined loop:
 
-        1. dispatch decode chunk N+1 from the device-resident state — the
-           device never waits for the host;
-        2. fetch + process chunk N's tokens, overlapped with chunk N+1
-           executing on device — this is where rows finish and free;
+        1. dispatch decode group N+1 from the device-resident state — ONE
+           jitted program covering ``group_chunks`` fused chunks while
+           busy (a single chunk at low load) — the device never waits for
+           the host;
+        2. fetch + process group N's packed results, overlapped with group
+           N+1 executing on device — this is where rows finish and free;
         3. resolve the admission dispatched last step (host bookkeeping —
            its merge already executed on device);
         4. dispatch admissions for the rows phase 2 just freed; their
-           prefill + insert + merge land between chunk N+1 and N+2, so a
-           finished row is back in service after exactly one idle chunk.
+           prefill + insert + merge land between group N+1 and N+2, so a
+           finished row is back in service after exactly one idle group.
 
         Rows keep their exact solo tokens (row isolation is positional,
         and the device state never depends on host processing) — the
-        pipeline only delays when the *host* learns them by one chunk.
+        pipeline only delays when the *host* learns them by one group.
         """
         self._process_cancellations()
 
         if not self.active:
             # Nothing running: drain the pipeline, then admit directly
             # (resolve immediately — nothing to overlap with; the merge
-            # makes rows live for the next step's first chunk).
+            # makes rows live for the next step's first group).
             if self._inflight is not None:
-                chunk, self._inflight = self._inflight, None
+                group, self._inflight = self._inflight, None
                 self._last_fetch_t = None
-                n = self._process_chunk(chunk)
+                n = self._process_group(group)
                 n += self._resolve_admission(self._pending_adm)
                 self._pending_adm = None
                 return n
@@ -1040,40 +1113,50 @@ class ContinuousBatcher:
 
         done, eos_arr, sa = self._chunk_args()
         busy = len(self.active) >= (3 * self.rows) // 4
-        k = self.chunk_steps if busy else self.chunk_steps_low
-        t_bucket = self.engine.decode_bucket(
-            max(self._row_pos.values(), default=0) + k
+        # Busy → the full group of full chunks (host off the critical
+        # path); low load → one short chunk (admission/TTFT granularity).
+        # Exactly these two (n_chunks, n_steps) combos exist, so the
+        # executable envelope stays two programs per cache-read bucket —
+        # same count as the ungrouped two-chunk-size scheme.
+        nc, k = (
+            (self.group_chunks, self.chunk_steps) if busy
+            else (1, self.chunk_steps_low)
         )
-        toks, cache, cur_pos, _, poisoned = self.engine._decode_many(
+        t_bucket = self.engine.decode_bucket(
+            max(self._row_pos.values(), default=0) + nc * k
+        )
+        t0 = time.perf_counter()
+        packed, last_tok, cache, cur_pos, _ = self.engine._decode_group(
             self.engine.params, self._tokens_dev, self.cache,
             self._cur_pos_dev, sa, jnp.asarray(done), jnp.asarray(eos_arr),
-            n_steps=k, t_bucket=t_bucket,
+            n_chunks=nc, n_steps=k, t_bucket=t_bucket,
         )
         for row in self._row_pos:
-            self._row_pos[row] += k
+            self._row_pos[row] += nc * k
         self.cache = self.engine.canon_cache(cache)
         self._cur_pos_dev = self.engine.canon_vec(cur_pos)
-        self._tokens_dev = self.engine.canon_vec(toks[:, -1])
+        self._tokens_dev = self.engine.canon_vec(last_tok)
         try:
-            toks.copy_to_host_async()
-            poisoned.copy_to_host_async()
+            packed.copy_to_host_async()
         except AttributeError:
             pass
+        self.engine.metrics.host_dispatch.record(time.perf_counter() - t0)
+        self.engine.metrics.add_group()
         # The admission dispatched LAST step sits between the previous
-        # chunk and this one on the device queue, so this chunk's
+        # group and this one on the device queue, so this group's
         # fetch-to-fetch interval includes its prefill+insert+merge time.
-        chunk = _InFlightChunk(
-            toks=toks, k=k, has_admission=self._pending_adm is not None,
-            poisoned=poisoned,
+        group = _InFlightGroup(
+            packed=packed, n_chunks=nc, k=k,
+            has_admission=self._pending_adm is not None,
         )
 
-        prev, self._inflight = self._inflight, chunk
+        prev, self._inflight = self._inflight, group
         n = 0
         if prev is not None:
-            n = self._process_chunk(prev)  # frees finished rows
+            n = self._process_group(prev)  # frees finished rows
         n += self._resolve_admission(self._pending_adm)
         # Admission takes the rows processing just freed; its device work
-        # overlaps the in-flight chunk and lands before the next one.
+        # overlaps the in-flight group and lands before the next one.
         self._pending_adm = self._admit_dispatch()
         self._step_count += 1
         return n

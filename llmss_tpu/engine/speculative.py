@@ -14,12 +14,16 @@ workloads with lookup hits come out ahead — with no distribution drift:
 every emitted token is the argmax of the model's logits given the true
 prefix.
 
-**Everything runs on device in fused chunks**: the n-gram lookup, the
-verify forward, acceptance, the history append, and EOS handling chain
-inside one ``lax.scan`` of ``m`` speculative steps per dispatch — the
-host fetches one chunk result per round-trip, exactly like
-``_decode_many`` (a host-side draft loop was measured 10x SLOWER through
-a ~100 ms-RTT host link: one round-trip per ~3.5 tokens).
+**Everything runs on device in fused groups**: the n-gram lookup, the
+verify forward, acceptance, the history append, and EOS handling run
+inside ONE jitted program of ``m`` scanned speculative steps per group
+(``spec_group_impl``), with the group's choices/emits/state packed into
+a single flat array inside the jit — one dispatch and one device→host
+fetch per group, exactly the grouped-decode discipline of
+``DecodeEngine._decode_group`` (a host-side draft loop was measured 10x
+SLOWER through a ~100 ms-RTT host link: one round-trip per ~3.5
+tokens; chained per-step dispatch still paid ~10 ms of host exec
+overhead per verify — SPEC_BENCH.json's 0.82x wall-clock).
 
 Exactness scope: verification is exact *under the verify forward's own
 numerics*. When the S=gamma+1 forward and the S=1 decode step lower to
@@ -48,6 +52,7 @@ The reference has no speculation of any kind (one token per
 
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import numpy as np
@@ -103,8 +108,16 @@ def _device_draft(hist: jax.Array, L: jax.Array, gamma: int, ngram: int):
         s_best = jnp.max(jnp.where(hit, iota, -1))
         found = s_best >= 0
         cont_idx = s_best + n + jnp.arange(gamma, dtype=jnp.int32)
+        # Positions past the live history pad with the CONTINUATION's last
+        # in-range element — the host reference's ``out.append(out[-1])``
+        # rule, stated literally (a truncated continuation always ends at
+        # ``hist[L-1]``, so this pad VALUE equals the row's last token; the
+        # code now encodes the documented rule rather than relying on that
+        # coincidence). A hit guarantees ``s_best + n < L``, so the pad
+        # index is in range whenever ``found`` (and masked out otherwise).
+        pad = hist[jnp.clip(jnp.minimum(cont_idx[-1] + 1, L) - 1, 0, H - 1)]
         cont = jnp.where(
-            cont_idx < L, hist[jnp.clip(cont_idx, 0, H - 1)], last
+            cont_idx < L, hist[jnp.clip(cont_idx, 0, H - 1)], pad
         )
         take = found & ~found_any
         draft = jnp.where(take, cont, draft)
@@ -117,11 +130,12 @@ def spec_step_impl(
     *, gamma: int, ngram: int = 3, t_bucket: int | None = None,
 ):
     """One speculative step as a single jit: device draft → verify
-    forward → acceptance → EOS/ring handling → history append. The host
-    dispatches several of these back-to-back (async, like the chained
-    decode chunks — dispatches don't block) and fetches the batched
-    results once per group: a ``lax.scan`` version measured ~60% slower
-    per verify than chained calls (worse cross-iteration scheduling).
+    forward → acceptance → EOS/ring handling → history append. Full-size
+    groups of these run as ONE scanned program (``spec_group_impl`` —
+    one dispatch + one packed fetch per group); the chained-dispatch
+    form remains the ring-constrained partial-group path, where a
+    bespoke grouped executable per residual group size would compile at
+    every ring boundary.
 
     hist [B, H] int32 — prompt + emitted tokens (no EOS); hist_len [B].
     Returns (choice [B, gamma+1], n_emit [B], hist, hist_len, cache,
@@ -189,6 +203,57 @@ def spec_step_impl(
     return choice, n_emit, hist, hist_len, cache, done
 
 
+def spec_group_impl(
+    cfg, mesh, params, hist, hist_len, cache, done, eos,
+    *, m: int, gamma: int, ngram: int = 3, t_bucket: int | None = None,
+):
+    """A GROUP of ``m`` speculative steps as ONE jitted program: an outer
+    ``lax.scan`` over ``spec_step_impl`` with the result packing moved
+    inside the jit — the same grouped-dispatch discipline as the main
+    decode path (``DecodeEngine._decode_group``). The host pays one
+    dispatch and one packed fetch per group instead of one dispatch per
+    verify forward, which is what deletes the per-verify host exec
+    overhead the chained-dispatch loop still paid (~10 ms/verify measured
+    through the serving tunnel — SPEC_BENCH.json's 0.82x wall-clock was
+    entirely that tax).
+
+    Returns ``(packed, hist, hist_len, cache, done)`` where ``packed`` is
+    the flat int32 array ``[m·B·S choices | m·B emits | B hist_len |
+    B done]`` — byte-identical to the layout the host previously
+    concatenated from chained step outputs, so the unpack code is shared.
+    """
+    # Pin the stacked ys to a replicated sharding: GSPMD otherwise
+    # propagates an unreduced partial-sum layout from the tp-sharded
+    # logits into the scan's stacked outputs, and the host reads choices
+    # summed over the tp axis (tp× their true value — the same hazard
+    # fixed in DecodeEngine._decode_group_impl). The carry is immune;
+    # only the ys leave the loop unconstrained.
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rep = NamedSharding(mesh, PartitionSpec()) if mesh is not None else None
+    pin = (
+        (lambda x: jax.lax.with_sharding_constraint(x, rep))
+        if rep is not None else (lambda x: x)
+    )
+
+    def body(carry, _):
+        hist, hist_len, cache, done = carry
+        choice, n_emit, hist, hist_len, cache, done = spec_step_impl(
+            cfg, mesh, params, hist, hist_len, cache, done, eos,
+            gamma=gamma, ngram=ngram, t_bucket=t_bucket,
+        )
+        return (hist, hist_len, cache, done), (pin(choice), pin(n_emit))
+
+    (hist, hist_len, cache, done), (choices, emits) = jax.lax.scan(
+        body, (hist, hist_len, cache, done), None, length=m,
+    )
+    packed = jnp.concatenate([
+        choices.reshape(-1), emits.reshape(-1), hist_len,
+        done.astype(jnp.int32),
+    ])
+    return packed, hist, hist_len, cache, done
+
+
 def generate_speculative(
     engine,
     prompts: list[list[int]],
@@ -236,6 +301,25 @@ def generate_speculative(
                     gamma=gamma, ngram=ngram, t_bucket=t_bucket,
                 ),
                 donate_argnums=(3,),
+            )
+            engine.__dict__[key] = fn
+        return fn
+
+    def get_group(t_bucket):
+        # One grouped program per (group size, draft params, bucket) —
+        # cached on the engine like the step jits so CompileGuard sees it.
+        # Only the FULL group size compiles (partial groups near the ring
+        # chain the step jit instead), bounding the executable count.
+        key = ("_spec_group", chunk_steps, gamma, ngram, t_bucket)
+        fn = engine.__dict__.get(key)
+        if fn is None:
+            fn = jax.jit(
+                partial(
+                    spec_group_impl, engine.cfg, engine.mesh,
+                    m=chunk_steps, gamma=gamma, ngram=ngram,
+                    t_bucket=t_bucket,
+                ),
+                donate_argnums=(1, 3),  # hist, cache
             )
             engine.__dict__[key] = fn
         return fn
@@ -308,34 +392,48 @@ def generate_speculative(
         # Bucketed cache reads for the whole group: every live row's
         # positions stay under live_hi + m·S by the guard above.
         # (Frozen rows' dead windows may read truncated garbage — unread.)
-        step = get_step(engine.decode_bucket(live_hi + m * S))
-        group = []
-        for _ in range(m):
-            # Raw jit outputs feed straight back in — a canon rewrap per
-            # carried array here costs a host round-trip EACH on remote
-            # backends (4/step × 8 steps ≈ the whole group's device time).
-            # The executable set stabilizes after at most one extra
-            # compile per bucket (self-consistent output→input cycle).
-            choice, n_emit, hist, hist_len, cache, done = step(
+        tb = engine.decode_bucket(live_hi + m * S)
+        t0 = time.perf_counter()
+        if m == chunk_steps:
+            # Full group: ONE jitted program covers all m verify steps
+            # with the packing inside the jit (spec_group_impl) — one
+            # dispatch + one fetch per group; per-verify host exec
+            # overhead disappears.
+            packed_dev, hist, hist_len, cache, done = get_group(tb)(
                 engine.params, hist, hist_len, cache, done, eos,
             )
-            group.append((choice, n_emit))
-        n_forwards += len(group)
-        # ONE host fetch for the whole group: every blocking fetch costs
-        # a full host<->device round-trip (~100 ms through the serving
-        # tunnel) — per-step fetches were measured to dominate the whole
-        # phase. Pack [m,B,S] choices + [m,B] emits + hist_len + done
-        # into a single flat device array.
-        m = len(group)
-        packed_dev = jnp.concatenate(
-            [jnp.stack([c for c, _ in group]).reshape(-1)]
-            + [jnp.stack([e for _, e in group]).reshape(-1)]
-            + [hist_len, done.astype(jnp.int32)]
-        )
-        # Deliberate single fetch per speculative group: the packing above
+        else:
+            # Ring-constrained partial group: chain the per-step jit (a
+            # grouped program per residual m would compile a bespoke
+            # executable near every ring boundary) and pack on the host.
+            step = get_step(tb)
+            group = []
+            for _ in range(m):
+                # Raw jit outputs feed straight back in — a canon rewrap
+                # per carried array here costs a host round-trip EACH on
+                # remote backends (4/step × 8 steps ≈ the whole group's
+                # device time). The executable set stabilizes after at
+                # most one extra compile per bucket (self-consistent
+                # output→input cycle).
+                choice, n_emit, hist, hist_len, cache, done = step(
+                    engine.params, hist, hist_len, cache, done, eos,
+                )
+                group.append((choice, n_emit))
+            packed_dev = jnp.concatenate(
+                [jnp.stack([c for c, _ in group]).reshape(-1)]
+                + [jnp.stack([e for _, e in group]).reshape(-1)]
+                + [hist_len, done.astype(jnp.int32)]
+            )
+        n_forwards += m
+        engine.metrics.host_dispatch.record(time.perf_counter() - t0)
+        engine.metrics.add_group()
+        # Deliberate single fetch per speculative group: the packed layout
         # exists precisely so the whole group's choices/emits/state cross
         # the host link in ONE transfer instead of per-step fetches.
-        packed = np.asarray(packed_dev)  # lint: ignore[host-sync-in-loop]
+        with engine.metrics.host_fetch.time():
+            packed = np.asarray(packed_dev)  # lint: ignore[host-sync-in-loop]
+        engine.metrics.add_host_sync()
+        t_cb = time.perf_counter()
         ch_np = packed[: m * B * S].reshape(m, B, S)
         ne_np = packed[m * B * S: m * B * (S + 1)].reshape(m, B)
         hl_host = packed[m * B * (S + 1): m * B * (S + 1) + B]
@@ -354,6 +452,7 @@ def generate_speculative(
         # Push host-side (max_new) completions into the device done mask.
         if (done_np & ~dev_done).any():
             done = engine.canon_vec(jnp.asarray(dev_done | done_np))
+        engine.metrics.host_callback.record(time.perf_counter() - t_cb)
 
     # Ring-constrained tail (a full speculative window no longer fits):
     # plain CHUNKED decode via _decode_many — including past the ring

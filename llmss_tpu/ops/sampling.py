@@ -54,6 +54,30 @@ def nonfinite_rows(logits: jax.Array) -> jax.Array:
     return ~jnp.all(jnp.isfinite(logits), axis=-1)
 
 
+def fold_step_outcome(
+    logits: jax.Array,  # [B, V] the step's last-position logits
+    tok: jax.Array,  # [B] int32 the step's sampled token
+    done: jax.Array,  # [B] bool carry
+    poisoned: jax.Array,  # [B] bool carry
+    eos: jax.Array,  # [B] int32 per-row EOS id (-1 = none)
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Fold one decode step's EOS / non-finite outcome into the scanned
+    decode carry: rows that were already done — or whose logits just went
+    non-finite — emit an EOS fill instead of a sampled token, poisoned
+    rows are forced done (their later steps are fills the host discards),
+    and a row sampling its EOS finishes. One definition for every fused
+    decode scan (``_decode_many``, the grouped decode, prewarm) so the
+    chunked and grouped paths share the carry semantics bit-for-bit.
+
+    Returns the updated ``(tok, done, poisoned)``.
+    """
+    bad = nonfinite_rows(logits) & ~done
+    poisoned = poisoned | bad
+    tok = jnp.where(done | bad, eos, tok)
+    done = done | bad | (tok == eos)
+    return tok, done, poisoned
+
+
 def row_keys(seeds: jax.Array, counters: jax.Array) -> jax.Array:
     """[B] PRNG keys, one per batch row: fold the token counter into the
     request seed's key stream."""

@@ -370,6 +370,7 @@ class ContinuousWorker:
         poll_timeout_s: float = 0.02,
         chunk_steps: int = 8,
         chunk_steps_low: int | None = None,
+        group_chunks: int = 1,
         worker_id: str | None = None,
         snapshot_interval_s: float = 1.0,
     ):
@@ -380,7 +381,7 @@ class ContinuousWorker:
         self.tokenizer = tokenizer
         self.batcher = ContinuousBatcher(
             engine, rows=rows, chunk_steps=chunk_steps,
-            chunk_steps_low=chunk_steps_low,
+            chunk_steps_low=chunk_steps_low, group_chunks=group_chunks,
         )
         self.poll_timeout_s = poll_timeout_s
         self._publish_counter = 0
@@ -635,6 +636,14 @@ def main(argv=None):
         help="decode steps per host round-trip (1 = per-token streaming "
              "granularity; higher amortizes host-link latency)",
     )
+    parser.add_argument(
+        "--group_chunks", type=int, default=1,
+        help="continuous batching only: fused decode chunks dispatched as "
+             "ONE jitted program while busy — host syncs and dispatch "
+             "overhead scale per group instead of per chunk, at the cost "
+             "of admission granularity stretching to group_chunks x "
+             "chunk_steps tokens (docs/decode-loop.md)",
+    )
     parser.add_argument("--tp", type=int, default=None)
     parser.add_argument("--dp", type=int, default=1)
     parser.add_argument(
@@ -720,7 +729,9 @@ def main(argv=None):
         if args.continuous:
             w = ContinuousWorker(
                 engine, broker, tokenizer, rows=args.batch_size,
-                chunk_steps=args.chunk_steps, worker_id=args.worker_id,
+                chunk_steps=args.chunk_steps,
+                group_chunks=args.group_chunks,
+                worker_id=args.worker_id,
                 snapshot_interval_s=args.snapshot_interval_s,
             )
         else:

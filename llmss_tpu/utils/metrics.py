@@ -86,7 +86,20 @@ class EngineMetrics:
         self.ttft = LatencyStat("ttft")
         self.decode_step = LatencyStat("decode_step")
         self.prefill = LatencyStat("prefill")
+        # Per-group host-overhead breakdown for the grouped decode path:
+        # dispatch (host time to enqueue a group's jitted program, incl.
+        # canonical-sharding rewraps), fetch (the blocking packed
+        # device→host transfer), callback (host bookkeeping — token
+        # accounting, stream flushes, row frees). ``host_syncs`` counts
+        # blocking device→host fetches; ``groups_dispatched`` counts
+        # grouped programs enqueued — together they put a number on how
+        # often the host touches the device per token.
+        self.host_dispatch = LatencyStat("host_dispatch")
+        self.host_fetch = LatencyStat("host_fetch")
+        self.host_callback = LatencyStat("host_callback")
         self._lock = threading.Lock()
+        self.host_syncs = 0  # guarded_by: self._lock
+        self.groups_dispatched = 0  # guarded_by: self._lock
         self.tokens_generated = 0  # guarded_by: self._lock
         self.requests_served = 0  # guarded_by: self._lock
         self.errors = 0  # guarded_by: self._lock
@@ -143,6 +156,16 @@ class EngineMetrics:
         with self._lock:
             self.kv_block_evictions += n
 
+    def add_host_sync(self, n: int = 1) -> None:
+        """A blocking device→host fetch crossed the link."""
+        with self._lock:
+            self.host_syncs += n
+
+    def add_group(self, n: int = 1) -> None:
+        """A grouped decode program was dispatched."""
+        with self._lock:
+            self.groups_dispatched += n
+
     def to_dict(self) -> dict:
         uptime = time.monotonic() - self._start
         with self._lock:
@@ -154,6 +177,7 @@ class EngineMetrics:
                 self.kv_blocks_total, self.kv_blocks_in_use,
                 self.kv_block_evictions,
             )
+            syncs, groups = self.host_syncs, self.groups_dispatched
         return {
             "uptime_s": round(uptime, 1),
             "requests_served": reqs,
@@ -169,6 +193,13 @@ class EngineMetrics:
             "ttft": self.ttft.to_dict(),
             "prefill": self.prefill.to_dict(),
             "decode_step": self.decode_step.to_dict(),
+            "host_overhead": {
+                "host_syncs": syncs,
+                "groups_dispatched": groups,
+                "dispatch": self.host_dispatch.to_dict(),
+                "fetch": self.host_fetch.to_dict(),
+                "callback": self.host_callback.to_dict(),
+            },
             **(
                 {"speculative": self.spec_stats}
                 if self.spec_stats is not None else {}

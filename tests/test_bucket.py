@@ -138,7 +138,7 @@ def test_worker_prewarm_compiles_each_executable_once(setup):
     sizes = {
         "prefill": eng._prefill._cache_size(),
         "decode": eng._decode._cache_size(),
-        "decode_many": eng._decode_many._cache_size(),
+        "decode_group": eng._decode_group._cache_size(),
     }
     prompts = [[5, 9, 23, 40], [3, 14, 15, 9, 26, 5], [7], [2, 4]]
     gen = GenerationParams(max_new_tokens=30, is_greedy=True)
@@ -151,7 +151,7 @@ def test_worker_prewarm_compiles_each_executable_once(setup):
     ))
     assert eng._prefill._cache_size() == sizes["prefill"]
     assert eng._decode._cache_size() == sizes["decode"]
-    assert eng._decode_many._cache_size() == sizes["decode_many"]
+    assert eng._decode_group._cache_size() == sizes["decode_group"]
 
 
 def test_submit_rejects_ring_overflow(setup):

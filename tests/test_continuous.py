@@ -258,3 +258,131 @@ def test_generate_chunked_matches_single(engine):
     b = engine.generate(prompts, gens, chunk_steps=4)
     c = engine.generate(prompts, gens, chunk_steps=64)
     assert a == b == c
+
+
+# -- grouped dispatch: bit-identity with the chunked path ---------------------
+
+
+def _run_jobs(engine, jobs, *, rows, chunk_steps, group_chunks,
+              interleave_after=0):
+    """Run ``jobs`` [(prompt, gen)] through a fresh batcher; returns
+    {req_id: (tokens, error)}. ``interleave_after`` submits that many jobs
+    up front and the rest only after two scheduler steps, so admissions
+    land while earlier rows are mid-group."""
+    b = ContinuousBatcher(
+        engine, rows=rows, chunk_steps=chunk_steps,
+        group_chunks=group_chunks,
+    )
+    got = {}
+
+    def cb_for(rid):
+        def cb(toks, cancelled=False, error=None):
+            got[rid] = (list(toks), error)
+        return cb
+
+    head = jobs[:interleave_after] if interleave_after else jobs
+    tail = jobs[interleave_after:] if interleave_after else []
+    for rid, (p, g) in enumerate(head):
+        b.submit(p, g, cb_for(rid), req_id=str(rid))
+    if tail:
+        b.step()
+        b.step()
+        for rid, (p, g) in enumerate(tail, start=len(head)):
+            b.submit(p, g, cb_for(rid), req_id=str(rid))
+    b.run_until_idle()
+    assert len(got) == len(jobs)
+    return got
+
+
+def test_grouped_matches_chunked_interleaved(engine):
+    """group_chunks batches host syncs only: with admissions landing
+    mid-stream, every request's tokens must be identical to the
+    group_chunks=1 scheduler (which test_chunked_step_matches_single_step
+    already pins to the single-step path)."""
+    jobs = [
+        ([5, 9, 23], GenerationParams(max_new_tokens=11, is_greedy=True)),
+        ([3, 14], GenerationParams(max_new_tokens=3, is_greedy=True)),
+        ([40, 41, 42, 43], GenerationParams(
+            max_new_tokens=7, is_greedy=False, temperature=0.9, top_k=12,
+            seed=5,
+        )),
+        ([7, 11], GenerationParams(max_new_tokens=9, is_greedy=True)),
+        ([2, 4, 8], GenerationParams(max_new_tokens=5, is_greedy=True)),
+    ]
+    base = _run_jobs(engine, jobs, rows=3, chunk_steps=2, group_chunks=1,
+                     interleave_after=2)
+    grouped = _run_jobs(engine, jobs, rows=3, chunk_steps=2, group_chunks=3,
+                        interleave_after=2)
+    assert grouped == base
+
+
+def test_grouped_eos_mid_group(engine):
+    """A row hitting EOS inside a group must emit exactly the pre-EOS
+    tokens: the device EOS-fills the rest of the group, and the host must
+    never read the fills as output."""
+    probe = engine.generate(
+        [[1, 2, 3, 4]], GenerationParams(max_new_tokens=8, is_greedy=True)
+    )[0]
+    eos = probe[2]  # a token the greedy stream provably emits mid-flight
+    jobs = [
+        ([1, 2, 3, 4], GenerationParams(
+            max_new_tokens=12, is_greedy=True, eos_token_id=eos)),
+        ([9, 8, 7], GenerationParams(max_new_tokens=12, is_greedy=True)),
+    ]
+    base = _run_jobs(engine, jobs, rows=2, chunk_steps=2, group_chunks=1)
+    grouped = _run_jobs(engine, jobs, rows=2, chunk_steps=2, group_chunks=3)
+    assert grouped == base
+    # The EOS row stopped early (before its max_new_tokens budget).
+    assert len(base[0][0]) < 12 and base[0][1] is None
+
+
+def test_grouped_poison_mid_group(engine):
+    """A row poisoned mid-group errors out with the tokens produced before
+    the poison — at the same boundary as the ungrouped path — and its
+    batch-mates keep their exact streams."""
+    gen = GenerationParams(max_new_tokens=8, is_greedy=True)
+
+    def run(group_chunks):
+        b = ContinuousBatcher(
+            engine, rows=2, chunk_steps=2, group_chunks=group_chunks,
+        )
+        orig = engine._decode_group
+        got = {}
+
+        def cb_for(rid):
+            def cb(toks, cancelled=False, error=None):
+                got[rid] = (list(toks), error)
+            return cb
+
+        def poisoning(*a, **k):
+            # Flip the packed poisoned flag (layout: nc*B*k tokens then
+            # nc*B per-chunk flags) for req "bad"'s row in every chunk of
+            # the group, from its first live dispatch on.
+            packed, last_tok, cache, cur_pos, done = orig(*a, **k)
+            bad_row = next(
+                (row for row, r in b.active.items()
+                 if r.req_id == "bad" and not r.awaiting_first), None,
+            )
+            if bad_row is not None:
+                nc, steps = k["n_chunks"], k["n_steps"]
+                base_i = nc * b.rows * steps
+                for c in range(nc):
+                    packed = packed.at[base_i + c * b.rows + bad_row].set(1)
+            return packed, last_tok, cache, cur_pos, done
+
+        engine._decode_group = poisoning
+        try:
+            b.submit([5, 6, 7], gen, cb_for("good"), req_id="good")
+            b.submit([9, 9], gen, cb_for("bad"), req_id="bad")
+            b.run_until_idle()
+        finally:
+            engine._decode_group = orig
+        return got
+
+    base = run(1)
+    grouped = run(3)
+    assert grouped == base
+    assert "poisoned" in (base["bad"][1] or "")
+    assert base["good"][1] is None
+    solo = engine.generate([[5, 6, 7]], gen)[0]
+    assert base["good"][0] == solo

@@ -139,3 +139,30 @@ def test_no_steady_state_recompiles(engine):
     with guard.steady_state():
         engine.generate(prompts, gen)
         engine.generate(prompts, gen)
+
+
+def test_no_steady_state_recompiles_grouped(engine):
+    """CompileGuard over the GROUPED decode path: a warmed batcher running
+    group_chunks>1 traffic — including the low-load single-chunk shape and
+    mid-stream admissions — must never key a fresh compile. The grouped
+    scheduler's whole point is fewer host round-trips; a silent mid-serve
+    recompile would hand the savings straight back."""
+    from llmss_tpu.analysis import CompileGuard
+    from llmss_tpu.engine.scheduler import ContinuousBatcher
+
+    batcher = ContinuousBatcher(
+        engine, rows=4, chunk_steps=2, group_chunks=3,
+    )
+    batcher.prewarm()
+    gen = GenerationParams(max_new_tokens=6, is_greedy=True)
+
+    guard = CompileGuard.for_engine(engine)
+    assert guard._fns, "engine exposes no jitted callables to guard"
+    with guard.steady_state():
+        got = {}
+        for i, p in enumerate([[5, 9], [3, 14, 15], [7, 8, 9, 10]]):
+            batcher.submit(p, gen, lambda t, i=i: got.__setitem__(i, t))
+        batcher.step()
+        batcher.submit([11, 12], gen, lambda t: got.__setitem__(9, t))
+        batcher.run_until_idle()
+        assert len(got) == 4

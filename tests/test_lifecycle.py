@@ -412,20 +412,27 @@ def test_scheduler_poisons_row_without_touching_batchmates(small_engine):
     solo = small_engine.generate([[5, 6, 7]], gp)[0]
 
     batcher = ContinuousBatcher(small_engine, rows=2, chunk_steps=2)
-    orig = small_engine._decode_many
+    orig = small_engine._decode_group
 
     def poisoning(*a, **k):
-        toks, cache, cur_pos, done, poisoned = orig(*a, **k)
+        # Tamper with the grouped program's PACKED output: flip the
+        # per-chunk poisoned flag (layout: n_chunks*B*k tokens, then
+        # n_chunks*B flags) for the bad row in every chunk.
+        packed, last_tok, cache, cur_pos, done = orig(*a, **k)
         bad_row = next(
             (row for row, r in batcher.active.items()
              if r.req_id == "bad" and not r.awaiting_first),
             None,
         )
         if bad_row is not None:
-            poisoned = poisoned.at[bad_row].set(True)
-        return toks, cache, cur_pos, done, poisoned
+            nc, steps = k["n_chunks"], k["n_steps"]
+            B = batcher.rows
+            base = nc * B * steps
+            for c in range(nc):
+                packed = packed.at[base + c * B + bad_row].set(1)
+        return packed, last_tok, cache, cur_pos, done
 
-    small_engine._decode_many = poisoning
+    small_engine._decode_group = poisoning
     try:
         done = {}
 
@@ -444,7 +451,7 @@ def test_scheduler_poisons_row_without_touching_batchmates(small_engine):
                 break
             batcher.step()
     finally:
-        small_engine._decode_many = orig
+        small_engine._decode_group = orig
 
     assert "poisoned" in (done["bad"][1] or "")
     good_toks, good_err = done["good"]
@@ -461,14 +468,22 @@ def test_engine_generate_reports_poisoned_rows(small_engine):
     gp = GenerationParams(max_new_tokens=6, is_greedy=True)
     solo = small_engine.generate([[11, 12]], gp)[0]
 
-    orig = small_engine._decode_many
+    orig = small_engine._decode_group
 
     def poisoning(*a, **k):
-        toks, cache, cur_pos, done, poisoned = orig(*a, **k)
-        return toks, cache, cur_pos, done, poisoned.at[0].set(True)
+        # Flip row 0's poisoned flag in the grouped program's packed
+        # output (n_chunks*B*k tokens, then n_chunks*B flags; B = the
+        # tokens carry's row count).
+        packed, last_tok, cache, cur_pos, done = orig(*a, **k)
+        nc, steps = k["n_chunks"], k["n_steps"]
+        B = a[1].shape[0]
+        base = nc * B * steps
+        for c in range(nc):
+            packed = packed.at[base + c * B + 0].set(1)
+        return packed, last_tok, cache, cur_pos, done
 
     flagged = set()
-    small_engine._decode_many = poisoning
+    small_engine._decode_group = poisoning
     try:
         outs = small_engine.generate(
             [[3, 4], [11, 12]],
@@ -478,7 +493,7 @@ def test_engine_generate_reports_poisoned_rows(small_engine):
             chunk_steps=2,  # the chunked (serving) path carries the flag
         )
     finally:
-        small_engine._decode_many = orig
+        small_engine._decode_group = orig
     assert flagged == {0}
     assert outs[1] == solo, "poison leaked into a batch-mate's tokens"
 

@@ -194,3 +194,63 @@ def test_serving_prefix_token_ids_end_to_end(setup):
         prefix_token_ids=[9, 9],
     ))
     assert bad.error is not None and "prefix" in bad.error
+
+
+def test_engine_prefix_near_ring_falls_back_to_scratch(setup):
+    """Ring-wrap guard: a request that FITS (prompt + max_new <= ring) but
+    whose BUCKET-padded suffix would reach past the ring must not seed the
+    prefix — padded prefill columns still compute slots
+    (slot = position % max_len), so the wrapped columns would overwrite
+    the just-seeded prefix KV. The engine falls back to a from-scratch
+    full-prompt prefill: identical tokens, no corruption."""
+    cfg, params, mesh = setup
+    eng = DecodeEngine(cfg, params, mesh, max_seq_len=64)
+    long_prefix = list(range(1, 53))  # 52 tokens; suffix bucket 16 wraps
+    pfx = eng.build_prefix(long_prefix)
+    prompts = [long_prefix + [60, 61, 62, 63]]  # 56 + 6 new <= 64: legal
+    gen = GenerationParams(max_new_tokens=6, is_greedy=True)
+    scratch_run = eng.generate(prompts, gen, chunk_steps=2)
+
+    calls = []
+    orig = eng._prefill
+
+    def spy(params, ids, cache, lens, sa, *rest):
+        calls.append(ids.shape)
+        return orig(params, ids, cache, lens, sa, *rest)
+
+    eng._prefill = spy
+    try:
+        reused_run = eng.generate(prompts, gen, chunk_steps=2, prefix=pfx)
+    finally:
+        eng._prefill = orig
+    assert reused_run == scratch_run
+    # Fallback is observable: the prefill saw the FULL prompt padded to
+    # its own bucket (56 -> 64), not a 16-wide suffix at start=52.
+    assert calls == [(1, 64)]
+
+
+def test_scheduler_prefix_near_ring_admits_without_prefix(setup):
+    """The same ring-wrap guard at scheduler admission: a submit whose
+    bucket-padded suffix would wrap past the ring is admitted WITHOUT the
+    prefix (from-scratch prefill) and still emits its exact solo tokens,
+    alongside a safe prefix request that keeps its seeded admission."""
+    cfg, params, mesh = setup
+    eng = DecodeEngine(cfg, params, mesh, max_seq_len=64)
+    long_prefix = list(range(1, 53))
+    pfx_long = eng.build_prefix(long_prefix)
+    pfx_safe = eng.build_prefix(PREFIX)
+    gen = GenerationParams(max_new_tokens=6, is_greedy=True)
+
+    wrap_p = long_prefix + [60, 61, 62, 63]
+    safe_p = PREFIX + [50, 51]
+    solo = eng.generate([wrap_p, safe_p], gen)
+
+    b = ContinuousBatcher(eng, rows=2, chunk_steps=2)
+    got = {}
+    b.submit(wrap_p, gen, lambda t: got.__setitem__("wrap", t),
+             prefix=pfx_long)
+    b.submit(safe_p, gen, lambda t: got.__setitem__("safe", t),
+             prefix=pfx_safe)
+    b.run_until_idle()
+    assert got["wrap"] == solo[0]
+    assert got["safe"] == solo[1]

@@ -204,7 +204,7 @@ def test_prewarm_covers_all_shapes(serving):
         "prefill_row": b._prefill_row._cache_size(),
         "insert": b._insert._cache_size(),
         "decode": engine._decode._cache_size(),
-        "decode_many": engine._decode_many._cache_size(),
+        "decode_group": engine._decode_group._cache_size(),
     }
 
     # Prompt lengths spanning every bucket (engine max_seq_len caps them),
@@ -234,7 +234,7 @@ def test_prewarm_covers_all_shapes(serving):
     # jit outputs, and insert sits downstream of all of them.
     assert b._prefill_row._cache_size() == sizes["prefill_row"]
     assert engine._decode._cache_size() == sizes["decode"]
-    assert engine._decode_many._cache_size() == sizes["decode_many"]
+    assert engine._decode_group._cache_size() == sizes["decode_group"]
     assert b._insert._cache_size() <= sizes["insert"] + 2
 
 

@@ -111,3 +111,34 @@ def test_stats_reset_between_calls(engine):
     # the previous call's numbers.
     engine.generate_speculative([[3, 1, 4, 1] * 16], gen, gamma=4)
     assert engine.metrics.spec_stats["verify_forwards"] == 0
+
+
+def test_device_draft_matches_host_reference():
+    """Fuzz parity of the vectorized device draft against the host-side
+    reference rule, INCLUDING the padding path: continuations truncated by
+    the live length must pad exactly like the reference's
+    ``out.append(out[-1])`` — on periodic prompts the bucket-padded device
+    history otherwise drafts from stale pad slots and silently degrades
+    acceptance."""
+    import jax.numpy as jnp
+
+    from llmss_tpu.engine.speculative import _device_draft
+
+    rng = np.random.default_rng(7)
+    H = 32
+    fn = jax.jit(_device_draft, static_argnums=(2, 3))
+    for trial in range(200):
+        L = int(rng.integers(1, H + 1))
+        vocab = int(rng.integers(2, 6))  # tiny vocab: frequent n-gram hits
+        h = rng.integers(0, vocab, size=L).astype(np.int32)
+        gamma = int(rng.integers(1, 6))
+        ngram = int(rng.integers(1, 4))
+        # Device histories are bucket-padded with garbage past L — the
+        # draft must never read it as signal.
+        hist = np.full(H, 99, np.int32)
+        hist[:L] = h
+        want = lookup_draft(h.tolist(), gamma, ngram)
+        got = np.asarray(
+            fn(jnp.asarray(hist), jnp.int32(L), gamma, ngram)
+        ).tolist()
+        assert got == want, (trial, h.tolist(), gamma, ngram, got, want)

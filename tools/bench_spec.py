@@ -23,9 +23,12 @@ import jax
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from bench import flagship_cfg  # noqa: E402
+from profile_decode import host_overhead_breakdown  # noqa: E402
 
+MODEL = os.environ.get("SPEC_MODEL", "1b2")
 BATCH = int(os.environ.get("SPEC_BATCH", 16))
 PROMPT = int(os.environ.get("SPEC_PROMPT", 128))
 DECODE = int(os.environ.get("SPEC_DECODE", 256))
@@ -38,7 +41,7 @@ def main():
     from llmss_tpu.parallel import MeshPlan, make_mesh
 
     mesh = make_mesh(MeshPlan(tp=len(jax.devices())))
-    cfg = flagship_cfg("1b2")
+    cfg = flagship_cfg(MODEL)
     params = init_params(cfg, mesh, jax.random.key(0))
     engine = DecodeEngine(
         cfg, params, mesh, max_seq_len=PROMPT + DECODE + GAMMA + 1,
@@ -112,7 +115,8 @@ def main():
         "metric": "speculative_decode_speedup",
         "value": round(t_plain / t_spec, 3),
         "unit": (
-            f"x wall-clock vs chunked greedy on THIS host (1b2 bf16, "
+            f"x wall-clock vs chunked greedy on THIS host "
+            f"({MODEL} bf16 on {jax.default_backend()}, "
             f"batch={BATCH}, {DECODE} new tokens, gamma={GAMMA}: "
             f"{n_tok / t_spec:.0f} vs {n_tok / t_plain:.0f} tok/s, "
             f"{stats['mean_tokens_per_forward_per_row']} tok/row/verify; "
@@ -130,7 +134,12 @@ def main():
             os.path.abspath(__file__))), "SPEC_BENCH.json"), "w") as f:
         json.dump({**result, "spec_stats": stats,
                    "plain_s": round(t_plain, 2),
-                   "spec_s": round(t_spec, 2)}, f, indent=1)
+                   "spec_s": round(t_spec, 2),
+                   # Accumulated over the plain + speculative runs above:
+                   # the grouped dispatch pays ONE packed fetch per group,
+                   # so spec verify loops dominate host_syncs here.
+                   "host_overhead": host_overhead_breakdown(
+                       engine.metrics)}, f, indent=1)
 
 
 if __name__ == "__main__":

@@ -41,9 +41,31 @@ from bench import (  # noqa: E402
     _MODEL_RUN, DECODE, HBM_GBPS, PROMPT, flagship_cfg, slope_time,
 )
 
-BATCH = int(os.environ.get("BENCH_BATCH", 0)) or _MODEL_RUN["1b2"]["batch"]
+MODEL = os.environ.get("PROFILE_MODEL", "1b2")
+BATCH = int(os.environ.get("BENCH_BATCH", 0)) or _MODEL_RUN[MODEL]["batch"]
 
 TRACE_DIR = os.environ.get("PROFILE_TRACE_DIR", "/tmp/llmss_profile")
+
+
+def host_overhead_breakdown(metrics) -> dict:
+    """Per-group host-overhead receipts from an ``EngineMetrics``: how
+    much host time each grouped-decode dispatch costs (enqueue + canon
+    rewraps), what the ONE packed device→host fetch per group blocks for,
+    and what the host-side bookkeeping (token accounting, stream flushes)
+    adds — plus the sync/dispatch counters that say how often the host
+    touches the device at all. Shared by bench_serve.py and
+    tools/bench_spec.py so both bench JSONs carry the same breakdown."""
+    ho = metrics.to_dict()["host_overhead"]
+    return {
+        "host_syncs": ho["host_syncs"],
+        "groups_dispatched": ho["groups_dispatched"],
+        "dispatch_ms": {k: ho["dispatch"][k]
+                        for k in ("mean_ms", "p50_ms", "p95_ms")},
+        "fetch_ms": {k: ho["fetch"][k]
+                     for k in ("mean_ms", "p50_ms", "p95_ms")},
+        "callback_ms": {k: ho["callback"][k]
+                        for k in ("mean_ms", "p50_ms", "p95_ms")},
+    }
 
 
 def _build():
@@ -53,7 +75,7 @@ def _build():
 
     n_dev = len(jax.devices())
     mesh = make_mesh(MeshPlan(tp=n_dev))
-    cfg = flagship_cfg()
+    cfg = flagship_cfg(MODEL)
     params = init_params(cfg, mesh, jax.random.key(0))
     engine = DecodeEngine(cfg, params, mesh, max_seq_len=PROMPT + DECODE)
     return cfg, params, mesh, engine
@@ -352,6 +374,9 @@ def main():
         },
         "tok_per_sec_at_full": round(BATCH / full * 1e3, 1),
         "n_trace_ops": len(ops) if ops else 0,
+        # Accumulated over the ablation runs above — what the host paid
+        # per grouped dispatch while the device did the work.
+        "host_overhead": host_overhead_breakdown(engine.metrics),
     }))
     if ops:
         with open("/tmp/llmss_ops.json", "w") as f:
