@@ -88,6 +88,35 @@ _MODEL_RUN = {
 BATCH = int(os.environ.get("BENCH_BATCH", 0))  # 0 = per-model default
 
 
+def bench_provenance() -> dict:
+    """Host/accelerator provenance stamped into every bench JSON.
+
+    Every ``*_BENCH.json`` / ``BENCH_*.json`` writer in the repo includes
+    this block so a reader can tell a CPU-backend functional run from a
+    real-TPU run without parsing the ``unit`` string. Lazy ``jax`` import:
+    pure-CPU benches (tools/bench_router.py) reach here without having
+    initialized a backend, and the stamp itself is what forces it.
+    """
+    import platform as _plat
+
+    out = {"python": _plat.python_version(), "machine": _plat.machine()}
+    try:
+        import jax as _jax
+
+        dev = _jax.devices()[0]
+        out.update(
+            backend=_jax.default_backend(),
+            platform=dev.platform,
+            device_kind=dev.device_kind,
+            device_count=_jax.device_count(),
+        )
+    except Exception:  # no JAX / no backend: still stamp the host
+        out.update(
+            backend=None, platform=_plat.system().lower(), device_count=0
+        )
+    return out
+
+
 def flagship_cfg(model: str = "1b2"):
     from llmss_tpu.models.common import DecoderConfig
 
@@ -401,6 +430,7 @@ def run_paged_ab(model: str) -> dict:
         }
         del engine
     result["tokens_identical_engine"] = toks_ab["dense"] == toks_ab["paged"]
+    result["provenance"] = bench_provenance()
 
     # -- capacity half: same KV byte budget, 2x the concurrent rows ------
     rows_d = batch

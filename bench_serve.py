@@ -252,10 +252,12 @@ def main():
         "vs_baseline": round(capacity["tok_s_chip"] / roofline, 3),
     }
     print(json.dumps(result))
+    from bench import bench_provenance
+
     with open("SERVE_BENCH.json", "w") as f:
         json.dump(
             {**result, "sla_ms": SLA_MS, "best_sla": best_sla,
-             "sweep": sweep},
+             "sweep": sweep, "provenance": bench_provenance()},
             f, indent=1,
         )
 

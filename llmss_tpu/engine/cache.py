@@ -23,6 +23,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from llmss_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
@@ -373,6 +374,73 @@ def paged_write_stacked(
     through unmapped table entries are dropped."""
     blk, off = logical_to_physical(block_tables, slots, block_size)
     return pool.at[:, blk, off].set(new.astype(pool.dtype), mode="drop")
+
+
+def export_blocks(
+    cache: PagedKVCache, block_ids, n_tokens: int,
+) -> dict:
+    """Host-side copy of one row's first ``len(block_ids)`` logical blocks
+    — the KV payload a prefill replica hands to a decode replica
+    (serve/handoff.py). A pure READ of the pool: tables, positions, and
+    allocator refcounts are untouched, so COW-shared prefix blocks can be
+    exported while other rows keep referencing them.
+
+    Tail slots at logical position >= ``n_tokens`` are zeroed: they hold
+    whatever a previous tenant of the block left behind, and leaking that
+    into the wire payload would make the bytes (and their checksum)
+    nondeterministic across otherwise identical prefills.
+
+    Returns ``{"k", "v", "k_scale", "v_scale"}`` as host numpy arrays of
+    shape ``[L, nb, bs, Hkv, D]`` (scales ``[L, nb, bs, Hkv]``, None on
+    bf16 pools).
+    """
+    ids = np.asarray(block_ids, np.int32)
+    nb = len(ids)
+    bs = cache.block_size
+    if not 0 < n_tokens <= nb * bs:
+        raise ValueError(
+            f"n_tokens {n_tokens} outside (0, {nb} blocks * {bs}]"
+        )
+    valid = (np.arange(nb * bs) < n_tokens).reshape(nb, bs)
+    dev_ids = jnp.asarray(ids)
+
+    def grab(pool):
+        if pool is None:
+            return None
+        seg = np.asarray(jax.device_get(pool[:, dev_ids]))
+        mask = valid.reshape((1, nb, bs) + (1,) * (seg.ndim - 3))
+        return np.where(mask, seg, np.zeros_like(seg))
+
+    return {
+        "k": grab(cache.k), "v": grab(cache.v),
+        "k_scale": grab(cache.k_scale), "v_scale": grab(cache.v_scale),
+    }
+
+
+def import_blocks(
+    cache: PagedKVCache, k, v, k_scale, v_scale, block_ids,
+) -> PagedKVCache:
+    """Scatter exported block payloads into the pool at ``block_ids``
+    ([nb] int32; sentinel entries drop under mode="drop", so callers may
+    pad nb to a power of two for a bounded compile envelope). The decode
+    replica's half of the KV handoff: after this scatter + a table/position
+    install, the adopted row decodes as if it had prefilled locally.
+    Pure function — the scheduler jits it with the pool donated."""
+
+    def put(pool, seg):
+        if pool is None:
+            return None
+        if seg is None:
+            return None
+        return pool.at[:, block_ids].set(
+            jnp.asarray(seg).astype(pool.dtype), mode="drop"
+        )
+
+    return cache._replace(
+        k=put(cache.k, k), v=put(cache.v, v),
+        k_scale=put(cache.k_scale, k_scale),
+        v_scale=put(cache.v_scale, v_scale),
+    )
 
 
 class BlockAllocator:

@@ -54,7 +54,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from llmss_tpu.engine.cache import (
-    BlockAllocator, KVCache, PagedKVCache, table_sentinel,
+    BlockAllocator, KVCache, PagedKVCache, export_blocks, import_blocks,
+    table_sentinel,
 )
 from llmss_tpu.engine.engine import DecodeEngine, GenerationParams, _bucket
 
@@ -114,6 +115,7 @@ class ContinuousBatcher:
     def __init__(
         self, engine: DecodeEngine, *, rows: int = 8, chunk_steps: int = 1,
         chunk_steps_low: int | None = None, group_chunks: int = 1,
+        prefill_only: bool = False,
     ):
         # chunk_steps > 1 advances all rows that many tokens per scheduler
         # step (one fused scan instead of per-token dispatch); combined
@@ -158,6 +160,23 @@ class ContinuousBatcher:
         # only the BlockAllocator itself is cross-thread (metrics read it)
         # and carries its own lock.
         self._paged = engine.kv_layout == "paged"
+        # Prefill-only mode (disaggregated serving, serve/handoff.py):
+        # admission runs exactly as usual — seed + batched prefill into
+        # pool blocks — but instead of decoding, _resolve_admission
+        # EXPORTS each row's blocks through ``export_cb`` and frees the
+        # row immediately. No decode group ever dispatches (active is
+        # empty outside the admit->resolve window, so step() always takes
+        # the direct admit path). Requests whose answer IS the first
+        # token (max_new <= 1, or the prefill sampled EOS) are answered
+        # locally through done_cb — bit-identical to a unified worker.
+        # Paged-only: the block table is the transfer unit.
+        if prefill_only and engine.kv_layout != "paged":
+            raise ValueError("prefill_only requires kv_layout='paged'")
+        self.prefill_only = prefill_only
+        # Called as export_cb(req_id, first_token, n_tokens, blocks) with
+        # ``blocks`` the export_blocks() host-array dict; set by the
+        # serving layer before submitting.
+        self.export_cb: Callable[..., None] | None = None
         if self._paged:
             mb = engine.max_seq_len // engine.block_size
             n_blocks = engine.kv_blocks or rows * mb
@@ -180,6 +199,12 @@ class ContinuousBatcher:
             )
             self._seed_blocks = jax.jit(
                 self._seed_blocks_impl, donate_argnums=(0,)
+            )
+            # Decode-side adopt scatter (cache.import_blocks): block count
+            # pads to a power of two (sentinel ids drop), so the compile
+            # envelope is log2(max_blocks) programs.
+            self._import_blocks = jax.jit(
+                import_blocks, donate_argnums=(0,)
             )
         else:
             self.cache = engine.new_cache(rows)
@@ -834,6 +859,13 @@ class ContinuousBatcher:
             if first == eos or r.gen.max_new_tokens == 0:
                 self._finish(row, r)
                 continue
+            if self.prefill_only and r.gen.max_new_tokens > 1:
+                # Disaggregated prefill: export the row's blocks and free
+                # it — the decode replica owns the request from here.
+                # (max_new == 1 falls through: the first token IS the
+                # answer, shipping KV for it would be pure overhead.)
+                self._export_row(row, r, first)
+                continue
             r.out.append(first)
             self.engine.metrics.add_tokens(1)
             if len(r.out) >= r.gen.max_new_tokens:
@@ -843,6 +875,156 @@ class ContinuousBatcher:
                 # streaming's perceived TTFT is the point.
                 self._flush_stream(r)
         return n
+
+    def _export_row(self, row: int, r: _Row, first: int) -> None:
+        """Prefill-only epilogue for one admitted row: copy its blocks to
+        host (a pure pool read — COW-shared prefix blocks stay shared and
+        refcounted for the NEXT request; ``export_blocks`` zeroes slot
+        garbage past ``n_tokens``), free the row, then hand the payload
+        to ``export_cb``. Freeing first means an export_cb that throws
+        can't leak the row; the host copy is complete before the blocks
+        return to the pool, so reuse can't corrupt it."""
+        n_tokens = self._row_pos[row]
+        bs = self.engine.block_size
+        nb = -(-n_tokens // bs)
+        blk_ids = self._host_tables[row, :nb].copy()
+        blocks = export_blocks(self.cache, blk_ids, n_tokens)
+        cb = self.export_cb
+        self.active.pop(row, None)
+        self._row_pos.pop(row, None)
+        self._paged_release_row(row)
+        with self._lock:
+            self._free.append(row)
+        self.engine.metrics.add_tokens(1)
+        if cb is not None:
+            cb(r.req_id, first, n_tokens, blocks)
+
+    def adopt(
+        self,
+        req_id: str,
+        first_token: int,
+        n_tokens: int,
+        blocks: dict,
+        gen: GenerationParams,
+        done_cb: Callable[..., None],
+        stream_cb: Callable[[list[int]], None] | None = None,
+    ) -> bool:
+        """Decode-side half of the KV handoff: install an imported
+        prompt's blocks into a free row and decode from token ``n_tokens``
+        on, WITHOUT a prefill pass. Returns False (record untouched) when
+        no row or not enough pool blocks are free — the caller keeps the
+        record and retries while touching its handoff lease.
+
+        Bit-identity with a local prefill holds because every piece of
+        decode-visible state is reconstructed exactly: the pool bytes are
+        the exported ones (bf16/int8 round-trip is exact), positions are
+        the same arange-mask a local admission produces, and sampling is
+        stateless per (seed, position) so resuming at ``cur_pos =
+        n_tokens`` with ``tokens = first_token`` continues the identical
+        stream (tests/test_handoff.py).
+        """
+        if not self._paged:
+            raise ValueError("adopt requires kv_layout='paged'")
+        if self.prefill_only:
+            raise ValueError("prefill-only batcher cannot adopt")
+        gen.validate()
+        self.engine.check_capacity(n_tokens, gen.max_new_tokens)
+        eng = self.engine
+        bs = eng.block_size
+        nb = -(-n_tokens // bs)
+        k_seg = blocks["k"]
+        if k_seg is None or k_seg.shape[1] != nb:
+            raise ValueError(
+                f"payload has {None if k_seg is None else k_seg.shape[1]} "
+                f"blocks, prompt of {n_tokens} tokens needs {nb}"
+            )
+        if k_seg.shape[2] != bs:
+            raise ValueError(
+                f"payload block_size {k_seg.shape[2]} != engine {bs}"
+            )
+        if bool(blocks.get("k_scale") is not None) != self.cache.quantized:
+            raise ValueError(
+                "payload quantization does not match the engine's pool"
+            )
+        # All validation done — now take a row and the blocks.
+        with self._lock:
+            if not self._free:
+                return False
+            row = self._free.pop()
+        need = -(-(n_tokens + gen.max_new_tokens) // bs)
+        owned = self.allocator.alloc(need)
+        if owned is None and self._paged_evict_idle_prefixes():
+            owned = self.allocator.alloc(need)
+        if owned is None:
+            with self._lock:
+                self._free.append(row)
+            return False
+        self._row_owned[row] = owned
+        self._row_shared[row] = []
+        self._host_tables[row, :] = self._sentinel
+        self._host_tables[row, :need] = owned
+        eng.metrics.set_kv_blocks(in_use=self.allocator.blocks_in_use)
+
+        # Import scatter, block count padded to a power of two (sentinel
+        # ids drop) so the compile envelope stays log2(max_blocks).
+        P2 = 1
+        while P2 < nb:
+            P2 *= 2
+        ids = np.full(P2, self._sentinel, np.int32)
+        ids[:nb] = owned[:nb]
+
+        def padded(seg):
+            if seg is None:
+                return None
+            seg = np.asarray(seg)
+            if P2 == nb:
+                return seg
+            pad = np.zeros(
+                (seg.shape[0], P2 - nb) + seg.shape[2:], seg.dtype
+            )
+            return np.concatenate([seg, pad], axis=1)
+
+        cache = self._import_blocks(
+            self.cache, padded(blocks["k"]), padded(blocks["v"]),
+            padded(blocks.get("k_scale")), padded(blocks.get("v_scale")),
+            jnp.asarray(ids),
+        )
+        # Positions: the same arange-under-n_tokens mask a local
+        # admission's prefill writes; table upload cuts any stale mapping.
+        sub = np.full((1, eng.max_seq_len), -1, np.int32)
+        sub[0, :n_tokens] = np.arange(n_tokens, dtype=np.int32)
+        cache = cache._replace(
+            block_tables=self._dev_tables(self._host_tables),
+            positions=self._merge_positions(
+                cache.positions, eng.canon_vec(jnp.asarray(sub)),
+                jnp.asarray([row], jnp.int32),
+            ),
+        )
+        self.cache = eng.canon_cache(cache)
+        # Device decode state: resume at cur_pos = n_tokens with the
+        # prefill-sampled first token (the P=1 merge is prewarmed).
+        self._tokens_dev, self._cur_pos_dev = (
+            eng.canon_vec(x) for x in eng._admit_merge(
+                self._tokens_dev, self._cur_pos_dev,
+                eng.canon_vec(jnp.asarray([first_token], jnp.int32)),
+                jnp.asarray([n_tokens], jnp.int32),
+                jnp.asarray([row], jnp.int32),
+            )
+        )
+        r = _Row(
+            req_id=req_id, gen=gen, out=[first_token], done_cb=done_cb,
+            stream_cb=stream_cb, awaiting_first=False,
+            t_submit=time.perf_counter(),
+        )
+        self.active[row] = r
+        self._row_pos[row] = n_tokens
+        eng.metrics.add_request(1)
+        eng.metrics.add_tokens(1)
+        if len(r.out) >= gen.max_new_tokens:
+            self._finish(row, r)
+        else:
+            self._flush_stream(r)
+        return True
 
     def _finish(
         self, row: int, r: _Row, cancelled: bool = False,

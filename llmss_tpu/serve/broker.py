@@ -178,6 +178,73 @@ class Broker(abc.ABC):
             return "dead-letter"
         return "requeue"
 
+    # -- KV handoff channel (disaggregated prefill/decode) -------------------
+    # A prefill-role worker prefills a request, serializes its paged KV
+    # blocks (serve/handoff.py), and publishes a HandoffRecord; a
+    # decode-role worker adopts the blocks and streams the tokens. The
+    # record REPLACES the terminal response as the prefill worker's ack:
+    # ``push_handoff`` settles the request lease, and the record itself is
+    # leased to the decode worker with the same visibility-timeout /
+    # disposition semantics as requests — a decode replica dying
+    # mid-handoff sends the embedded request back to the SHARED queue for
+    # a fresh prefill (the exported KV died with the replica), a passed
+    # deadline sheds terminally, exhausted attempts dead-letter. Exactly
+    # one terminal response either way.
+
+    def push_handoff(self, record) -> None:
+        """Publish a finished KV export and settle the underlying
+        request's lease in one call. No benign default: a broker without
+        a handoff channel silently dropping the record would LOSE the
+        request (its lease was just settled), so minimal brokers must
+        refuse loudly — deployments on them stay unified-role."""
+        raise NotImplementedError("this broker has no KV handoff channel")
+
+    def push_handoff_to(self, worker_id: str, record) -> None:
+        """Enqueue onto one decode worker's routed handoff queue. Base
+        fallback: the shared handoff queue."""
+        self.push_handoff(record)
+
+    def pop_handoff(self, timeout: float = 0.0, worker_id: str | None = None):
+        """Lease the next HandoffRecord (routed first, then shared), or
+        None. The decode worker must answer (``push_response`` acks the
+        handoff lease too) or keep it fresh via ``touch_handoffs``."""
+        return None
+
+    def ack_handoff(self, request_id: str) -> None:  # noqa: B027
+        """Settle a handoff lease without answering (the adopting side
+        took ownership through some other terminal path)."""
+
+    def fail_handoff(self, record, error: str | None = None) -> None:  # noqa: B027
+        """A decode worker could not adopt the record (corrupt payload,
+        incompatible layout): settle its lease and run the standard
+        disposition NOW — re-prefill, dead-letter, or deadline-shed."""
+
+    def touch_handoffs(self, request_ids) -> None:  # noqa: B027
+        """Renew handoff leases this worker holds — called once per decode
+        chunk while generating from adopted blocks."""
+
+    def handoff_depth(self) -> int:
+        """Records waiting in the handoff channel (shared + routed)."""
+        return 0
+
+    def handoff_depths(self) -> dict:
+        """``{worker_id: depth}`` for non-empty routed handoff queues."""
+        return {}
+
+    def handoff_holders(self) -> dict:
+        """``{worker_id: n}`` handoff leases attributed to a worker — the
+        failover sweep's signal that a dead decode replica still holds
+        adopted (in-decode) records."""
+        return {}
+
+    def failover_handoffs(self, worker_id: str) -> list:
+        """Evacuate a dead decode worker's handoff traffic: drain its
+        routed-but-unleased records (returned for re-routing — the KV
+        payload is still valid, nothing re-prefills) and force-expire its
+        handoff leases through the standard disposition (those DO
+        re-prefill: the adopted device state died with the worker)."""
+        return []
+
     # Cancellation channel: the producer flags ids whose clients have gone
     # away (timeout / explicit cancel); workers query the flags for the ids
     # they hold and stop spending decode steps on them. The reference has
@@ -284,7 +351,15 @@ class InProcBroker(Broker):
         self._delivery_counts = {  # guarded_by: self._lease_lock
             "redelivered": 0, "dead_lettered": 0, "deadline_expired": 0,
             "failover_rerouted": 0,
+            "handoffs": 0, "handoff_bytes": 0, "reprefills": 0,
         }
+        # KV handoff channel (disaggregated prefill/decode): shared +
+        # per-decode-worker routed record queues, and handoff leases with
+        # the same shape as request leases.
+        self._handoffs: queue.Queue = queue.Queue()
+        self._handoff_routed: dict[str, queue.Queue] = {}  # guarded_by: self._route_lock
+        # rid -> (expiry, record, worker_id-or-None)
+        self._handoff_leases: dict[str, tuple[float, object, str | None]] = {}  # guarded_by: self._lease_lock
         # Fleet state: per-worker routed queues + TTL'd registry.
         self._routed: dict[str, queue.Queue] = {}  # guarded_by: self._route_lock
         self._route_lock = threading.Lock()
@@ -387,6 +462,149 @@ class InProcBroker(Broker):
                 ))
             else:
                 out.append(req)
+        if out:
+            with self._lease_lock:
+                self._delivery_counts["failover_rerouted"] += len(out)
+        return out
+
+    # -- KV handoff channel --------------------------------------------------
+
+    def _handoff_settled(self, record) -> None:
+        # The handoff IS the prefill worker's ack: the request lease is
+        # settled the moment the record is queued (queue first, then
+        # settle — a death in between leaves a duplicate hazard, never a
+        # loss, the same trade push_response makes).
+        with self._lease_lock:
+            self._leases.pop(record.req.id, None)
+            self._delivery_counts["handoffs"] += 1
+            self._delivery_counts["handoff_bytes"] += len(record.payload)
+
+    def push_handoff(self, record) -> None:
+        self._handoffs.put(record)
+        self._handoff_settled(record)
+
+    def push_handoff_to(self, worker_id: str, record) -> None:
+        with self._route_lock:
+            q = self._handoff_routed.setdefault(worker_id, queue.Queue())
+        q.put(record)
+        self._handoff_settled(record)
+
+    def pop_handoff(self, timeout: float = 0.0, worker_id: str | None = None):
+        self.reap_expired()
+        rec = None
+        if worker_id is not None:
+            with self._route_lock:
+                q = self._handoff_routed.get(worker_id)
+            if q is not None:
+                try:
+                    rec = q.get_nowait()
+                except queue.Empty:
+                    rec = None
+        if rec is None:
+            try:
+                rec = self._handoffs.get(timeout=timeout) if timeout else (
+                    self._handoffs.get_nowait()
+                )
+            except queue.Empty:
+                return None
+        with self._lease_lock:
+            self._handoff_leases[rec.req.id] = (
+                time.monotonic() + self.lease_s, rec, worker_id,
+            )
+        return rec
+
+    def touch_handoffs(self, request_ids) -> None:
+        now = time.monotonic()
+        with self._lease_lock:
+            for rid in request_ids:
+                held = self._handoff_leases.get(rid)
+                if held is not None:
+                    self._handoff_leases[rid] = (
+                        now + self.lease_s, held[1], held[2],
+                    )
+
+    def ack_handoff(self, request_id: str) -> None:
+        with self._lease_lock:
+            self._handoff_leases.pop(request_id, None)
+
+    def _dispose_handoff(self, record) -> None:
+        """Disposition for a handoff whose decode never completed:
+        requeue -> the embedded request returns to the SHARED request
+        queue for a fresh prefill (the exported KV died with the decode
+        replica — a re-prefill, not a redelivery); deadline / exhausted
+        attempts answer terminally exactly like a request-lease expiry."""
+        req = record.req
+        disp = self._expiry_disposition(req)
+        if disp == "expired":
+            with self._lease_lock:
+                self._delivery_counts["deadline_expired"] += 1
+            self.push_response(GenerateResponse(
+                id=req.id, error="deadline exceeded before completion",
+            ))
+        elif disp == "dead-letter":
+            with self._lease_lock:
+                self._delivery_counts["dead_lettered"] += 1
+                self._dlq.append(req)
+            self.push_response(GenerateResponse(
+                id=req.id,
+                error=(
+                    f"dead-lettered after {req.delivery_attempts} "
+                    "delivery attempts"
+                ),
+            ))
+        else:
+            with self._lease_lock:
+                self._delivery_counts["reprefills"] += 1
+            self._requests.put(req)
+
+    def fail_handoff(self, record, error: str | None = None) -> None:
+        self.ack_handoff(record.req.id)
+        self._dispose_handoff(record)
+
+    def handoff_depth(self) -> int:
+        with self._route_lock:
+            routed = sum(q.qsize() for q in self._handoff_routed.values())
+        return self._handoffs.qsize() + routed
+
+    def handoff_depths(self) -> dict:
+        with self._route_lock:
+            return {
+                wid: q.qsize() for wid, q in self._handoff_routed.items()
+                if q.qsize() > 0
+            }
+
+    def handoff_holders(self) -> dict:
+        holders: dict[str, int] = {}
+        with self._lease_lock:
+            for _t, _rec, wid in self._handoff_leases.values():
+                if wid is not None:
+                    holders[wid] = holders.get(wid, 0) + 1
+        return holders
+
+    def failover_handoffs(self, worker_id: str) -> list:
+        out: list = []
+        # Routed-but-unleased: the record (and its KV payload) is intact —
+        # it simply moves to a surviving decode worker, no re-prefill.
+        with self._route_lock:
+            q = self._handoff_routed.pop(worker_id, None)
+        if q is not None:
+            while True:
+                try:
+                    out.append(q.get_nowait())
+                except queue.Empty:
+                    break
+        # Leased in-flight: the adopted device state died with the worker
+        # — force-expire through the standard handoff disposition.
+        with self._lease_lock:
+            held = [
+                (rid, rec)
+                for rid, (_t, rec, wid) in self._handoff_leases.items()
+                if wid == worker_id
+            ]
+            for rid, _ in held:
+                del self._handoff_leases[rid]
+        for _rid, rec in held:
+            self._dispose_handoff(rec)
         if out:
             with self._lease_lock:
                 self._delivery_counts["failover_rerouted"] += len(out)
@@ -517,7 +735,22 @@ class InProcBroker(Broker):
                 with self._lease_lock:
                     self._delivery_counts["redelivered"] += 1
                 self._requests.put(req)
-        return len(dead)
+        # Expired handoff leases: the decode replica that adopted the
+        # blocks is presumed dead — standard handoff disposition
+        # (re-prefill / dead-letter / deadline-shed).
+        with self._lease_lock:
+            hdead = [
+                rec for _rid, (t, rec, _wid)
+                in self._handoff_leases.items() if t <= now
+            ]
+            for rid in [
+                rid for rid, (t, _rec, _wid)
+                in self._handoff_leases.items() if t <= now
+            ]:
+                del self._handoff_leases[rid]
+        for rec in hdead:
+            self._dispose_handoff(rec)
+        return len(dead) + len(hdead)
 
     def release_requests(self, request_ids) -> int:
         n = 0
@@ -553,18 +786,24 @@ class InProcBroker(Broker):
 
     def delivery_stats(self) -> dict:
         depth = self.queue_depth()
+        h_depth = self.handoff_depth()
         with self._lease_lock:
             return {
                 "queue_depth": depth,
                 "inflight": len(self._leases),
                 "dlq_depth": len(self._dlq),
+                "handoff_depth": h_depth,
+                "handoff_inflight": len(self._handoff_leases),
                 **self._delivery_counts,
             }
 
     def push_response(self, resp: GenerateResponse) -> None:
         # Terminal response = ack: the lease is settled, never redelivered.
+        # Handoff leases settle here too — the decode worker's answer IS
+        # its ack, same contract as the request lease.
         with self._lease_lock:
             self._leases.pop(resp.id, None)
+            self._handoff_leases.pop(resp.id, None)
         now = time.monotonic()
         with self._cond:
             for rid in [
@@ -648,6 +887,14 @@ class RedisBroker(Broker):
         # match "{pqueue}:worker:*" — the segment after "w" differs).
         self._worker_prefix = f"{request_queue}:worker"
         self._routed_prefix = f"{request_queue}:w"
+        # KV handoff channel: shared record list at {pqueue}:h, routed at
+        # {pqueue}:h:{wid} (the shared key has no trailing segment so the
+        # glob "{pqueue}:h:*" matches only routed queues, and cannot match
+        # "{pqueue}:hlease:*" — the segment differs), handoff leases at
+        # {pqueue}:hlease:{wid}:{rid} with the same embedded-expires_at
+        # scheme as request leases.
+        self._handoff_key = f"{request_queue}:h"
+        self._hlease_prefix = f"{request_queue}:hlease"
 
     # -- fleet registry ------------------------------------------------------
     # Worker ids must not contain ":" — they are embedded as key segments
@@ -766,6 +1013,156 @@ class RedisBroker(Broker):
             self._r.incr(f"{self._stats_prefix}:failover_rerouted")
         return out
 
+    # -- KV handoff channel --------------------------------------------------
+
+    def _routed_handoff_key(self, worker_id: str) -> str:
+        return f"{self._handoff_key}:{worker_id}"
+
+    def _hlease_key(self, request_id: str) -> str:
+        return f"{self._hlease_prefix}:{self._worker_id}:{request_id}"
+
+    def _handoff_settled(self, record) -> None:
+        # The handoff IS the prefill worker's ack (queue first, then
+        # settle — a death in between duplicates, never loses).
+        self._r.delete(self._lease_key(record.req.id))
+        self._r.incr(f"{self._stats_prefix}:handoffs")
+        self._r.incr(
+            f"{self._stats_prefix}:handoff_bytes", len(record.payload)
+        )
+
+    def push_handoff(self, record) -> None:
+        self._r.lpush(self._handoff_key, record.to_json())
+        self._handoff_settled(record)
+
+    def push_handoff_to(self, worker_id: str, record) -> None:
+        self._r.lpush(self._routed_handoff_key(worker_id), record.to_json())
+        self._handoff_settled(record)
+
+    def pop_handoff(self, timeout: float = 0.0, worker_id: str | None = None):
+        import json
+
+        from llmss_tpu.serve.handoff import HandoffRecord
+
+        self.reap_expired()
+        payload = None
+        if worker_id is not None:
+            if worker_id != self._worker_id:
+                # Same identity adoption as pop_request: the handoff lease
+                # key must carry the fleet id so acks and failover line up.
+                self._worker_id = worker_id
+            payload = self._r.rpop(self._routed_handoff_key(worker_id))
+        if not payload:
+            if timeout:
+                item = self._r.brpop(self._handoff_key, timeout=timeout)
+                payload = item[1] if item else None
+            else:
+                payload = self._r.rpop(self._handoff_key)
+        if not payload:
+            return None
+        rec = HandoffRecord.from_json(payload)
+        self._r.set(
+            self._hlease_key(rec.req.id),
+            json.dumps({
+                "expires_at": self._now() + self.lease_s,
+                "rec": rec.to_json(),
+            }),
+            ex=self._lease_ttl(),
+        )
+        return rec
+
+    def touch_handoffs(self, request_ids) -> None:
+        import json
+
+        for rid in request_ids:
+            key = self._hlease_key(rid)
+            raw = self._r.get(key)
+            if raw is None:
+                continue
+            entry = json.loads(raw)
+            entry["expires_at"] = self._now() + self.lease_s
+            self._r.set(key, json.dumps(entry), ex=self._lease_ttl())
+
+    def ack_handoff(self, request_id: str) -> None:
+        self._r.delete(self._hlease_key(request_id))
+
+    def _dispose_handoff(self, record) -> None:
+        req = record.req
+        disp = self._expiry_disposition(req)
+        if disp == "expired":
+            self._r.incr(f"{self._stats_prefix}:deadline_expired")
+            self.push_response(GenerateResponse(
+                id=req.id, error="deadline exceeded before completion",
+            ))
+        elif disp == "dead-letter":
+            self._r.incr(f"{self._stats_prefix}:dead_lettered")
+            self._r.lpush(self._dlq_key, req.to_json())
+            self.push_response(GenerateResponse(
+                id=req.id,
+                error=(
+                    f"dead-lettered after {req.delivery_attempts} "
+                    "delivery attempts"
+                ),
+            ))
+        else:
+            # Re-prefill: RPUSH so the (oldest) request heads the service
+            # order, exactly like a redelivery.
+            self._r.incr(f"{self._stats_prefix}:reprefills")
+            self._r.rpush(self._rq, req.to_json())
+
+    def fail_handoff(self, record, error: str | None = None) -> None:
+        self.ack_handoff(record.req.id)
+        self._dispose_handoff(record)
+
+    def handoff_depth(self) -> int:
+        return int(self._r.llen(self._handoff_key)) + sum(
+            self.handoff_depths().values()
+        )
+
+    def handoff_depths(self) -> dict:
+        out: dict[str, int] = {}
+        skip = len(self._handoff_key) + 1
+        for key in list(self._r.scan_iter(match=f"{self._handoff_key}:*")):
+            k = key.decode() if isinstance(key, bytes) else str(key)
+            depth = int(self._r.llen(k))
+            if depth:
+                out[k[skip:]] = depth
+        return out
+
+    def handoff_holders(self) -> dict:
+        holders: dict[str, int] = {}
+        skip = len(self._hlease_prefix) + 1
+        for key in list(self._r.scan_iter(match=f"{self._hlease_prefix}:*")):
+            k = key.decode() if isinstance(key, bytes) else str(key)
+            wid = k[skip:].rsplit(":", 1)[0]
+            holders[wid] = holders.get(wid, 0) + 1
+        return holders
+
+    def failover_handoffs(self, worker_id: str) -> list:
+        import json
+
+        from llmss_tpu.serve.handoff import HandoffRecord
+
+        out: list = []
+        while True:  # routed-but-unleased: payload intact, just moves
+            payload = self._r.rpop(self._routed_handoff_key(worker_id))
+            if not payload:
+                break
+            out.append(HandoffRecord.from_json(payload))
+        # Leased in-flight: adopted state died with the worker —
+        # claim-by-delete, then the standard handoff disposition.
+        match = f"{self._hlease_prefix}:{worker_id}:*"
+        for key in list(self._r.scan_iter(match=match)):
+            raw = self._r.get(key)
+            if raw is None:
+                continue
+            if not self._r.delete(key):
+                continue  # a reaper claimed it concurrently
+            rec = HandoffRecord.from_json(json.loads(raw)["rec"])
+            self._dispose_handoff(rec)
+        for _ in out:
+            self._r.incr(f"{self._stats_prefix}:failover_rerouted")
+        return out
+
     # -- lease plumbing -----------------------------------------------------
 
     def _lease_key(self, request_id: str) -> str:
@@ -853,6 +1250,21 @@ class RedisBroker(Broker):
                 # request goes to the head of the service order.
                 self._r.rpush(self._rq, req.to_json())
             n += 1
+        # Expired handoff leases: same claim-by-delete scheme, handoff
+        # disposition (re-prefill instead of redeliver).
+        from llmss_tpu.serve.handoff import HandoffRecord
+
+        for key in list(self._r.scan_iter(match=f"{self._hlease_prefix}:*")):
+            raw = self._r.get(key)
+            if raw is None:
+                continue
+            entry = json.loads(raw)
+            if entry["expires_at"] > now:
+                continue
+            if not self._r.delete(key):
+                continue  # another reaper claimed this lease
+            self._dispose_handoff(HandoffRecord.from_json(entry["rec"]))
+            n += 1
         return n
 
     def release_requests(self, request_ids) -> int:
@@ -895,15 +1307,21 @@ class RedisBroker(Broker):
         names = (
             "redelivered", "dead_lettered", "deadline_expired",
             "failover_rerouted",
+            "handoffs", "handoff_bytes", "reprefills",
         )
         vals = self._r.mget([f"{self._stats_prefix}:{k}" for k in names])
         inflight = sum(
             1 for _ in self._r.scan_iter(match=f"{self._lease_prefix}:*")
         )
+        handoff_inflight = sum(
+            1 for _ in self._r.scan_iter(match=f"{self._hlease_prefix}:*")
+        )
         return {
             "queue_depth": self.queue_depth(),
             "inflight": inflight,
             "dlq_depth": self.dlq_depth(),
+            "handoff_depth": self.handoff_depth(),
+            "handoff_inflight": handoff_inflight,
             **{k: int(v or 0) for k, v in zip(names, vals)},
         }
 
@@ -979,8 +1397,10 @@ class RedisBroker(Broker):
 
     def push_response(self, resp: GenerateResponse) -> None:
         # Terminal response == ack: release the lease so the reaper never
-        # redelivers completed work.
+        # redelivers completed work. Handoff leases settle here too — the
+        # decode worker's answer IS its ack.
         self._r.delete(self._lease_key(resp.id))
+        self._r.delete(self._hlease_key(resp.id))
         key = f"{self._prefix}:{resp.id}"
         self._r.lpush(key, resp.to_json())
         self._r.expire(key, 600)

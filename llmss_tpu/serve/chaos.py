@@ -276,6 +276,70 @@ class ScriptedEngine:
         self.metrics.add_tokens(sum(len(t) for t in outs[:n_live]))
         return [list(t) for t in outs]
 
+    # -- KV handoff protocol (serve.handoff role workers) --------------------
+    #
+    # The scripted "KV payload" is just the prompt, JSON-encoded: enough
+    # for adopt_generate to recompute the deterministic continuation, so
+    # handoff chaos tests can assert exact tokens across kills, failed
+    # adopts, and re-prefills — without a device or a real block pool.
+
+    def prefill_export(
+        self, token_ids: list[int], max_new_tokens: int,
+    ) -> tuple[int, bytes]:
+        """Prefill-role half: (first sampled token, serialized KV). Honors
+        the same fault switches as ``generate`` — a poison prompt crashes
+        the "chip" during prefill, and a tripped kill switch is machine
+        death before the export completes."""
+        import json as _json
+
+        self.generate_calls += 1
+        if self.kill_on_poison and POISON_TOKEN in token_ids:
+            raise HardKill("poison request: simulated chip reset")
+        if self.kill_switch is not None and self.kill_switch.is_set():
+            raise HardKill("chaos: kill switch tripped during prefill")
+        first = self.expected_tokens(token_ids, 1)[0]
+        payload = _json.dumps({"prompt": list(token_ids)}).encode()
+        self.metrics.add_request(1)
+        self.metrics.add_tokens(1)
+        return first, payload
+
+    def adopt_generate(
+        self, payload: bytes, max_new_tokens: int, first_token: int,
+        n_tokens: int, on_increment=None,
+    ) -> list[int]:
+        """Decode-role half: recompute the continuation from the scripted
+        payload and 'decode' it chunk by chunk (kill switch checked at
+        every chunk boundary — mid-decode death leaves the handoff lease
+        to expire). Payload/first-token mismatches raise ValueError, the
+        corrupt-record path (``fail_handoff`` -> re-prefill/DLQ)."""
+        import json as _json
+
+        self.generate_calls += 1
+        try:
+            prompt = _json.loads(payload)["prompt"]
+        except Exception as e:  # noqa: BLE001 — corrupt scripted payload
+            raise ValueError(f"bad scripted payload: {e}") from None
+        if len(prompt) != n_tokens:
+            raise ValueError(
+                f"payload has {len(prompt)} tokens, record says {n_tokens}"
+            )
+        toks = self.expected_tokens(prompt, max_new_tokens)
+        if toks and toks[0] != first_token:
+            raise ValueError(
+                f"first token mismatch: prefill said {first_token}, "
+                f"decode computed {toks[0]}"
+            )
+        for start in range(0, max_new_tokens, 8):
+            if self.kill_switch is not None and self.kill_switch.is_set():
+                raise HardKill("chaos: kill switch tripped mid-decode")
+            if self.chunk_delay_s:
+                time.sleep(self.chunk_delay_s)
+            if on_increment is not None:
+                on_increment()
+        self.metrics.add_request(1)
+        self.metrics.add_tokens(len(toks))
+        return toks
+
 
 class FakeRedis:
     """Minimal in-memory ``redis.Redis`` stand-in: exactly the primitives
@@ -347,11 +411,11 @@ class FakeRedis:
             self._expiry[key] = time.monotonic() + seconds
         return True
 
-    def incr(self, key):
+    def incr(self, key, amount=1):
         key = self._k(key)
         with self._cond:
             v = self._live(key)
-            n = int(v) + 1 if v is not None else 1
+            n = (int(v) if v is not None else 0) + int(amount)
             self._data[key] = str(n).encode()
         return n
 

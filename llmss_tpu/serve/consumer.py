@@ -23,6 +23,12 @@ import time
 
 from llmss_tpu.engine import DecodeEngine, GenerationParams
 from llmss_tpu.serve.broker import Broker
+from llmss_tpu.serve.handoff import (
+    HandoffRecord,
+    decode_blocks,
+    encode_blocks,
+    pick_decode_worker,
+)
 from llmss_tpu.serve.protocol import (
     STATE_DRAINING,
     STATE_READY,
@@ -34,12 +40,13 @@ from llmss_tpu.serve.protocol import (
 logger = logging.getLogger("llmss_tpu.serve")
 
 
-def worker_capabilities(worker_id: str, engine) -> dict:
+def worker_capabilities(worker_id: str, engine, role: str = "unified") -> dict:
     """Registration payload: identity + what this replica can serve.
     Tolerant of engine stand-ins (ScriptedEngine) that lack the attrs."""
     cfg = getattr(engine, "cfg", None)
     return {
         "worker_id": worker_id,
+        "role": role,
         "model": getattr(cfg, "model_type", None) or type(engine).__name__,
         "kv_layout": getattr(engine, "kv_layout", None),
         "kv_blocks": getattr(engine, "kv_blocks", None),
@@ -91,6 +98,7 @@ class Worker:
         # prefers its routed queue over the shared one. Without (default),
         # behavior is exactly the single-worker shared-queue stack.
         self.worker_id = worker_id
+        self.role = "unified"  # batch workers always prefill + decode
         self.snapshot_interval_s = snapshot_interval_s
         self._last_snapshot_t = 0.0
         self._inflight_rows = 0
@@ -121,7 +129,7 @@ class Worker:
         """(Re-)announce this worker in the fleet registry — called at
         construction and safe to call again after a registry TTL expiry."""
         self.broker.register_worker(
-            worker_capabilities(self.worker_id, self.engine)
+            worker_capabilities(self.worker_id, self.engine, self.role)
         )
         self._publish_load()
 
@@ -132,6 +140,7 @@ class Worker:
         import time as _time
 
         return {
+            "role": self.role,
             "state": STATE_DRAINING if self.draining else STATE_READY,
             "alive": True,
             "rows": self.batch_size,
@@ -359,7 +368,27 @@ class Worker:
 
 class ContinuousWorker:
     """Serving loop over the continuous batcher: requests are admitted into
-    the running batch at token granularity (BASELINE.md config #5)."""
+    the running batch at token granularity (BASELINE.md config #5).
+
+    ``role`` selects this replica's half of the disaggregated
+    prefill/decode split (docs/serving.md):
+
+    - ``"unified"`` (default): prefill + decode interleaved, exactly the
+      pre-disaggregation worker — single-worker deployments are
+      bit-identical.
+    - ``"prefill"``: the batcher runs prefill-only; each admitted request's
+      KV blocks are exported, wrapped in a :class:`HandoffRecord`, and
+      pushed onto the broker's handoff channel toward a decode replica.
+      Requests whose answer IS the first token (``max_new_tokens <= 1`` or
+      an immediate EOS) are answered locally — shipping KV for them would
+      be pure overhead.
+    - ``"decode"``: pops handoff records instead of raw requests, installs
+      the imported blocks via ``ContinuousBatcher.adopt`` (no prefill
+      pass), and decodes to completion. Records that arrive while all rows
+      are busy wait in a local backlog whose handoff leases are renewed
+      every ``run_once`` — never re-pushed, so no counter inflation and no
+      loss window.
+    """
 
     def __init__(
         self,
@@ -373,16 +402,31 @@ class ContinuousWorker:
         group_chunks: int = 1,
         worker_id: str | None = None,
         snapshot_interval_s: float = 1.0,
+        role: str = "unified",
     ):
+        from collections import deque
+
         from llmss_tpu.engine.scheduler import ContinuousBatcher
 
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"unknown worker role: {role!r}")
         self.engine = engine
         self.broker = broker
         self.tokenizer = tokenizer
+        self.role = role
         self.batcher = ContinuousBatcher(
             engine, rows=rows, chunk_steps=chunk_steps,
             chunk_steps_low=chunk_steps_low, group_chunks=group_chunks,
+            prefill_only=(role == "prefill"),
         )
+        # Prefill role: requests currently inside the batcher, keyed by id,
+        # so the export callback can attach the ORIGINAL request (sampling
+        # params, deadline, stream flag) to its HandoffRecord.
+        self._handoff_reqs: dict[str, GenerateRequest] = {}
+        if role == "prefill":
+            self.batcher.export_cb = self._on_export
+        # Decode role: popped-but-not-yet-adopted records (all rows busy).
+        self._adopt_backlog: "deque" = deque()
         self.poll_timeout_s = poll_timeout_s
         self._publish_counter = 0
         self.draining = False
@@ -405,7 +449,7 @@ class ContinuousWorker:
         """(Re-)announce this worker in the fleet registry — called at
         construction and safe to call again after a registry TTL expiry."""
         self.broker.register_worker(
-            worker_capabilities(self.worker_id, self.engine)
+            worker_capabilities(self.worker_id, self.engine, self.role)
         )
         self._publish_load()
 
@@ -420,9 +464,12 @@ class ContinuousWorker:
         hashes = set(snap.get("prefix_hashes") or [])
         hashes.update(prefix_hash(k) for k in self._prefixes)
         snap.update({
+            "role": self.role,
             "state": STATE_DRAINING if self.draining else STATE_READY,
             "alive": True,
-            "queue_depth": snap.get("pending", 0),
+            # Backlogged handoff records are load this worker has already
+            # committed to (their leases are ours) — routers should see it.
+            "queue_depth": snap.get("pending", 0) + len(self._adopt_backlog),
             "prefix_hashes": sorted(hashes),
             "heartbeat_s": self.snapshot_interval_s,
             # Cross-process staleness stamp (see Worker.load_snapshot).
@@ -490,37 +537,7 @@ class ContinuousWorker:
                 )
                 continue
 
-            def cb(toks, cancelled=False, error=None, req=req):
-                if error is not None:
-                    # Row-level failure (e.g. poison containment): the
-                    # batcher finished this row with an error; batch-mates
-                    # are untouched.
-                    self.engine.metrics.add_error()
-                    self.broker.push_response(
-                        GenerateResponse(
-                            id=req.id, error=error, token_ids=toks,
-                        )
-                    )
-                    return
-                if cancelled:
-                    # Honest response: the client timed out / went away;
-                    # partial tokens ride along, but this is not a success.
-                    self.broker.push_response(
-                        GenerateResponse(
-                            id=req.id, error="cancelled", token_ids=toks,
-                        )
-                    )
-                    return
-                text = (
-                    self.tokenizer.decode(toks)
-                    if self.tokenizer is not None else None
-                )
-                self.broker.push_response(
-                    GenerateResponse(
-                        id=req.id, prompt=req.prompt, continuation=text,
-                        token_ids=toks,
-                    )
-                )
+            cb = self._done_cb(req)
 
             stream_cb = None
             if req.stream:
@@ -532,16 +549,157 @@ class ContinuousWorker:
                     self._get_prefix(req.prefix_token_ids)
                     if req.prefix_token_ids else None
                 )
+                if self.role == "prefill":
+                    # Must be registered BEFORE submit: a short request
+                    # can resolve (and its done_cb clean this up) inside
+                    # the submit -> next step() window.
+                    self._handoff_reqs[req.id] = req
                 self.batcher.submit(
                     ids, gen, cb, req_id=req.id, stream_cb=stream_cb,
                     prefix=prefix,
                 )
             except ValueError as e:  # e.g. prompt + max_new exceeds the ring
+                self._handoff_reqs.pop(req.id, None)
                 self.broker.push_response(
                     GenerateResponse(id=req.id, error=str(e))
                 )
                 continue
             n += 1
+
+    def _done_cb(self, req: GenerateRequest):
+        """Completion closure for one request: turns the batcher's
+        (tokens, cancelled, error) outcome into exactly one broker
+        response. Shared by the submit path and the adopt path — on a
+        decode replica ``push_response`` doubles as the handoff ack."""
+
+        def cb(toks, cancelled=False, error=None):
+            self._handoff_reqs.pop(req.id, None)
+            if error is not None:
+                # Row-level failure (e.g. poison containment): the
+                # batcher finished this row with an error; batch-mates
+                # are untouched.
+                self.engine.metrics.add_error()
+                self.broker.push_response(
+                    GenerateResponse(id=req.id, error=error, token_ids=toks)
+                )
+                return
+            if cancelled:
+                # Honest response: the client timed out / went away;
+                # partial tokens ride along, but this is not a success.
+                self.broker.push_response(
+                    GenerateResponse(
+                        id=req.id, error="cancelled", token_ids=toks,
+                    )
+                )
+                return
+            text = (
+                self.tokenizer.decode(toks)
+                if self.tokenizer is not None else None
+            )
+            self.broker.push_response(
+                GenerateResponse(
+                    id=req.id, prompt=req.prompt, continuation=text,
+                    token_ids=toks,
+                )
+            )
+
+        return cb
+
+    # -- KV handoff: prefill side -------------------------------------------
+
+    def _on_export(self, rid: str, first: int, n_tokens: int, blocks) -> None:
+        """Batcher export callback (prefill role): serialize the row's
+        blocks and push the record toward a decode replica. ``push_handoff``
+        enqueues the record BEFORE settling the request lease, so a death
+        anywhere in here re-prefills elsewhere — never loses the request."""
+        req = self._handoff_reqs.pop(rid, None)
+        if req is None:  # defensive: submit registered it before the batcher
+            self.broker.push_response(
+                GenerateResponse(id=rid, error="exported request lost")
+            )
+            return
+        payload = encode_blocks(
+            blocks, req_id=rid, n_tokens=n_tokens,
+            block_size=self.engine.block_size,
+        )
+        rec = HandoffRecord(
+            req=req, first_token=first, n_tokens=n_tokens, payload=payload,
+        )
+        target = pick_decode_worker(
+            self.broker.read_workers(), self.broker.handoff_depths()
+        )
+        if target is None:
+            self.broker.push_handoff(rec)
+        else:
+            self.broker.push_handoff_to(target, rec)
+
+    # -- KV handoff: decode side --------------------------------------------
+
+    def _try_adopt(self, rec: HandoffRecord) -> bool:
+        """Install one handoff record into a free row. Returns False ONLY
+        when capacity-blocked (record untouched — caller holds it and
+        renews its lease); terminal outcomes (deadline, corrupt payload,
+        mismatched pool shape) consume the record and return True."""
+        req = rec.req
+        if req.deadline_ts is not None and time.time() > req.deadline_ts:
+            # Shed before adopting: push_response acks the handoff lease.
+            self.engine.metrics.add_expired()
+            self.broker.push_response(
+                GenerateResponse(id=req.id, error="deadline exceeded")
+            )
+            return True
+        try:
+            gen = gen_params_from(self.tokenizer, req)
+            d = decode_blocks(rec.payload)
+            blocks = {k: d[k] for k in ("k", "v", "k_scale", "v_scale")}
+        except Exception as e:  # noqa: BLE001 — corrupt payload quarantine
+            # fail_handoff re-queues the REQUEST (re-prefill makes a fresh
+            # payload); repeat offenders hit the delivery-attempt cap and
+            # dead-letter.
+            self.broker.fail_handoff(rec, error=str(e))
+            return True
+        stream_cb = None
+        if req.stream:
+            def stream_cb(new_toks, req=req):
+                self.broker.push_stream(req.id, new_toks)
+        try:
+            return self.batcher.adopt(
+                req.id, rec.first_token, rec.n_tokens, blocks, gen,
+                self._done_cb(req), stream_cb=stream_cb,
+            )
+        except Exception as e:  # noqa: BLE001 — e.g. block_size mismatch
+            self.broker.fail_handoff(rec, error=str(e))
+            return True
+
+    def _drain_handoffs(self, backlog_only: bool = False) -> int:
+        """Decode-role intake: adopt backlogged records first (FIFO — they
+        were popped earlier), then pop new ones while rows are free. A
+        capacity-blocked record goes to the backlog and stops the intake;
+        its lease is renewed each run_once until a row frees. Never
+        re-pushed: re-pushing would open a loss window and inflate the
+        handoff counters."""
+        n = 0
+        while self._adopt_backlog and self._try_adopt(self._adopt_backlog[0]):
+            self._adopt_backlog.popleft()
+            n += 1
+        if backlog_only:
+            return n
+        while not self._adopt_backlog:
+            rec = self.broker.pop_handoff(
+                timeout=(
+                    self.poll_timeout_s
+                    if self.batcher.idle and n == 0 else 0.0
+                ),
+                worker_id=self.worker_id,
+            )
+            if rec is None:
+                break
+            if self._try_adopt(rec):
+                n += 1
+            else:
+                self._adopt_backlog.append(rec)
+                break
+        return n
 
     def _get_prefix(self, prefix_ids: list[int]):
         """Retained prefix for these tokens, building (and LRU-evicting)
@@ -564,7 +722,10 @@ class ContinuousWorker:
 
     @property
     def drained(self) -> bool:
-        return self.draining and self.batcher.idle
+        return (
+            self.draining and self.batcher.idle
+            and not self._adopt_backlog
+        )
 
     def release_pending(self) -> int:
         """Drain-deadline fallback, half 1: requests this worker leased
@@ -587,12 +748,25 @@ class ContinuousWorker:
         # active alike — so only a genuinely dead worker's requests are
         # redelivered, never a busy one's.
         self.broker.touch_requests(live)
+        if self.role == "decode":
+            # Adopted rows and backlogged records are held under HANDOFF
+            # leases (their request leases were settled at push_handoff);
+            # renew those at the same cadence. Unknown ids are ignored.
+            self.broker.touch_handoffs(
+                live + [r.req.id for r in self._adopt_backlog]
+            )
         for rid in self.broker.check_cancelled(live):
             # The batcher frees the row at the top of its next step; the
             # request's done_cb fires with the tokens produced so far.
             self.batcher.cancel(rid)
         self._maybe_publish_load()
-        n = 0 if self.draining else self._drain_broker()
+        if self.role == "decode":
+            # Draining still adopts the backlog: those records are already
+            # this worker's responsibility (leased), and every adoption
+            # moves them toward their exactly-one terminal response.
+            n = self._drain_handoffs(backlog_only=self.draining)
+        else:
+            n = 0 if self.draining else self._drain_broker()
         self.batcher.step()
         self._publish_counter += 1
         # Every 16 iterations even when idle: with chunked steps (~0.3 s
@@ -606,7 +780,14 @@ class ContinuousWorker:
     def abort_inflight(self, reason: str) -> int:
         """Error out every admitted-but-unfinished request (supervisor
         teardown contract: every request gets a response, even across a
-        worker restart)."""
+        worker restart). Backlogged handoff records are returned via
+        ``fail_handoff`` — their requests re-queue for a fresh prefill on
+        a surviving replica instead of waiting out the lease timeout."""
+        while self._adopt_backlog:
+            self.broker.fail_handoff(
+                self._adopt_backlog.popleft(),
+                error=f"worker restarted: {reason}",
+            )
         ids = self.batcher.drain_all()
         for rid in ids:
             self.broker.push_response(
@@ -670,6 +851,21 @@ def main(argv=None):
              "redelivered (poison-request quarantine)",
     )
     parser.add_argument(
+        "--role", choices=["unified", "prefill", "decode"],
+        default="unified",
+        help="disaggregated serving role (docs/serving.md): 'prefill' "
+             "exports each request's KV blocks to the handoff channel "
+             "after prefill; 'decode' adopts handed-off blocks and decodes "
+             "them; 'unified' (default) does both — bit-identical to "
+             "pre-disaggregation single-worker serving. prefill/decode "
+             "require --continuous and --kv_layout paged",
+    )
+    parser.add_argument(
+        "--kv_layout", choices=["dense", "paged"], default="dense",
+        help="KV cache layout: 'paged' enables the block pool (COW "
+             "prefixes, KV handoff); 'dense' is the contiguous ring",
+    )
+    parser.add_argument(
         "--worker_id", default=None,
         help="fleet identity (no ':' allowed): register in the broker's "
              "worker registry, publish load snapshots, and serve this "
@@ -699,6 +895,11 @@ def main(argv=None):
              "error instead of pinning the shutdown",
     )
     args = parser.parse_args(argv)
+    if args.role != "unified":
+        if not args.continuous:
+            parser.error("--role prefill/decode requires --continuous")
+        if args.kv_layout != "paged":
+            parser.error("--role prefill/decode requires --kv_layout paged")
 
     from transformers import AutoTokenizer
 
@@ -714,6 +915,7 @@ def main(argv=None):
     cfg, params = load_model(args.pretrained_model_path, mesh, dtype=dtype)
     engine = DecodeEngine(
         cfg, params, mesh, kv_dtype=args.kv_dtype,
+        kv_layout=args.kv_layout,
         max_seq_len=args.max_seq_len or cfg.max_position_embeddings,
     )
     tokenizer = AutoTokenizer.from_pretrained(args.pretrained_model_path)
@@ -733,6 +935,7 @@ def main(argv=None):
                 group_chunks=args.group_chunks,
                 worker_id=args.worker_id,
                 snapshot_interval_s=args.snapshot_interval_s,
+                role=args.role,
             )
         else:
             w = Worker(
@@ -752,6 +955,7 @@ def main(argv=None):
     print(
         "consumer serving"
         + (" (continuous batching)" if args.continuous else "")
+        + (f" (role={args.role})" if args.role != "unified" else "")
         + (" (supervised)" if args.supervise else "")
     )
     import signal

@@ -48,6 +48,7 @@ import time
 
 from llmss_tpu.serve.broker import Broker
 from llmss_tpu.serve.chaos import ChaosWorkerHost
+from llmss_tpu.serve.handoff import pick_decode_worker
 from llmss_tpu.serve.protocol import (
     STATE_DEAD,
     STATE_READY,
@@ -87,6 +88,8 @@ def fleet_status(
     """Per-worker detail + fleet summary (producer ``GET /fleet``)."""
     depths = broker.routed_depths()
     holders = broker.lease_holders()
+    hdepths = broker.handoff_depths()
+    hholders = broker.handoff_holders()
     workers = {}
     ready = 0
     for wid, info in sorted(broker.read_workers().items()):
@@ -95,15 +98,19 @@ def fleet_status(
         ready += int(routable)
         workers[wid] = {
             **info,
+            "role": info.get("role", "unified"),
             "health": body.get("status"),
             "routable": routable,
             "routed_queue_depth": depths.get(wid, 0),
             "leases_held": holders.get(wid, 0),
+            "routed_handoff_depth": hdepths.get(wid, 0),
+            "handoff_leases_held": hholders.get(wid, 0),
         }
     out = {
         "workers": workers,
         "ready": ready,
         "queue_depth": broker.queue_depth(),
+        "handoff_depth": broker.handoff_depth(),
     }
     if router is not None:
         out["router"] = router.stats()
@@ -147,6 +154,7 @@ class Router:
             "routed_total": 0,
             "shared_fallback": 0,
             "failover_reroutes": 0,
+            "handoff_reroutes": 0,
             "affinity_hits": 0,
             "affinity_misses": 0,
         }
@@ -221,12 +229,21 @@ class Router:
     def routable_workers(self) -> dict[str, dict]:
         return routable_workers(self.broker, self.stale_factor)
 
+    def _request_targets(self) -> dict[str, dict]:
+        """Routable workers that accept RAW requests: everything except
+        decode-role replicas, which only consume the handoff channel — a
+        raw request routed there would sit unleased until failover."""
+        return {
+            wid: info for wid, info in self.routable_workers().items()
+            if info.get("role", "unified") != "decode"
+        }
+
     def submit(self, req: GenerateRequest) -> str | None:
         """Route onto one replica's queue; returns its worker_id, or None
         when no replica is routable (shared-queue fallback — any worker
         that appears later serves it)."""
         self.check_failover()
-        infos = self.routable_workers()
+        infos = self._request_targets()
         if not infos:
             with self._lock:
                 self._counts["shared_fallback"] += 1
@@ -252,10 +269,15 @@ class Router:
         ``dead`` when done."""
         depths = self.broker.routed_depths()
         holders = self.broker.lease_holders()
+        hdepths = self.broker.handoff_depths()
+        hholders = self.broker.handoff_holders()
         workers = self.broker.read_workers()
         targets = []
         for wid, info in workers.items():
-            if not depths.get(wid) and not holders.get(wid):
+            if (
+                not depths.get(wid) and not holders.get(wid)
+                and not hdepths.get(wid) and not hholders.get(wid)
+            ):
                 continue
             code, body = _worker_health(info, self.stale_factor)
             if code == 200:
@@ -272,6 +294,10 @@ class Router:
         targets.extend(
             wid for wid in depths if wid not in workers
         )
+        targets.extend(
+            wid for wid in hdepths
+            if wid not in workers and wid not in targets
+        )
         return targets
 
     def check_failover(self, force: bool = False) -> int:
@@ -282,18 +308,34 @@ class Router:
                 return 0
             self._next_failover = now + self.failover_check_s
         rerouted = 0
+        handoffs = 0
         for wid in self._failover_targets():
             for req in self.broker.failover_worker(wid):
-                infos = self.routable_workers()
+                infos = self._request_targets()
                 if infos:
                     self.broker.push_request_to(self._pick(req, infos), req)
                 else:
                     self.broker.push_request(req)
                 rerouted += 1
-        if rerouted:
+            # Handoff traffic: routed-but-unleased records come back with
+            # their KV payloads intact — re-route them to a surviving
+            # decode replica (no re-prefill). Leased ones were disposed
+            # inside failover_handoffs (their adopted device state died
+            # with the worker, so those DO re-prefill).
+            for rec in self.broker.failover_handoffs(wid):
+                target = pick_decode_worker(
+                    self.routable_workers(), self.broker.handoff_depths()
+                )
+                if target is None:
+                    self.broker.push_handoff(rec)
+                else:
+                    self.broker.push_handoff_to(target, rec)
+                handoffs += 1
+        if rerouted or handoffs:
             with self._lock:
                 self._counts["failover_reroutes"] += rerouted
-        return rerouted
+                self._counts["handoff_reroutes"] += handoffs
+        return rerouted + handoffs
 
     # -- observability -------------------------------------------------------
 

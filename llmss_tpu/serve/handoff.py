@@ -1,0 +1,377 @@
+"""KV handoff: disaggregated prefill/decode across the fleet.
+
+The paper's producer/consumer split stops at the queue — every worker
+still runs prefill and decode interleaved on one chip, so a long prompt's
+prefill steals decode steps from co-batched rows. Disaggregation (TPLA,
+PAPERS.md) splits the fleet by ROLE: a prefill replica pops requests,
+seeds the paged blocks, and ships them through a broker handoff channel;
+a decode replica adopts the blocks and streams tokens. The paged block
+table (PR 4) is the transfer unit — ``engine/cache.py export_blocks``
+produces the arrays, this module owns the wire format and the record.
+
+Delivery contract (rides the broker's at-least-once semantics):
+
+- ``push_handoff`` settles the request lease — the record REPLACES the
+  terminal response as the prefill worker's ack.
+- The record is leased to the decode worker (``pop_handoff``) with the
+  same visibility timeout; the worker touches it per decode chunk and
+  ``push_response`` acks it.
+- A handoff lease that expires (decode replica died mid-generation)
+  sends the embedded request back to the SHARED request queue for a
+  fresh prefill — a **re-prefill**, counted separately from
+  redeliveries, bounded by the same ``max_delivery_attempts``.
+- A prefill replica dying before ``push_handoff`` is the ordinary
+  request-lease expiry: redeliver, re-prefill elsewhere. Dying after is
+  free — the record is already in flight. Either way exactly one
+  terminal response (the response channel is consumed once by id).
+
+Wire format (``encode_blocks``/``decode_blocks``): a fixed magic +
+little-endian u32 header length + JSON header + concatenated raw
+buffers. The header carries dtypes/shapes/n_tokens/block_size and a
+CRC-32 of the buffer bytes; ``decode_blocks`` raises ``ValueError`` on
+any mismatch so a corrupt payload dispositions (``fail_handoff``)
+instead of poisoning a decode replica. Buffers are native little-endian
+``tobytes()`` — bf16 round-trips bit-exactly via ml_dtypes, int8+scales
+likewise, which is what makes the adopted row's tokens bit-identical to
+a local prefill (docs/paged-kv.md).
+
+Two serving stacks speak this channel:
+
+- ``ContinuousWorker(role=...)`` (serve/consumer.py) — the real
+  batcher-backed path: prefill-only admission + export on one replica,
+  ``ContinuousBatcher.adopt`` on the other.
+- ``PrefillWorker``/``DecodeWorker`` here — minimal engine-protocol
+  loops (``engine.prefill_export`` / ``engine.adopt_generate``, both
+  implemented by ``serve.chaos.ScriptedEngine``) used by the chaos
+  tests and ``tools/chaos_serve.py`` to prove the loss/duplication
+  contract without a model.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import struct
+import time
+import uuid
+import zlib
+
+import numpy as np
+
+from llmss_tpu.serve.protocol import (
+    STATE_READY, GenerateRequest, GenerateResponse,
+)
+
+#: Wire-format magic + version. Bump on any layout change — decoders
+#: refuse unknown versions instead of guessing.
+_MAGIC = b"LKVH"
+_VERSION = 1
+
+#: Buffer order in the payload body (None entries are skipped).
+_ARRAYS = ("k", "v", "k_scale", "v_scale")
+
+
+def _dtype_of(name: str):
+    """Wire dtype name -> numpy dtype. bf16 has no stock numpy name, so
+    the mapping is explicit (ml_dtypes ships with jax)."""
+    if name == "bfloat16":
+        import ml_dtypes  # gated: ships with jax
+
+        return np.dtype(ml_dtypes.bfloat16)
+    allowed = {"int8", "float32", "float16", "float64"}
+    if name not in allowed:
+        raise ValueError(f"unknown payload dtype {name!r}")
+    return np.dtype(name)
+
+
+def encode_blocks(
+    blocks: dict, *, req_id: str, n_tokens: int, block_size: int,
+) -> bytes:
+    """Serialize an ``export_blocks`` dict into the handoff wire format."""
+    bufs: list[bytes] = []
+    shapes: dict[str, list[int] | None] = {}
+    dtypes: dict[str, str | None] = {}
+    for name in _ARRAYS:
+        a = blocks.get(name)
+        if a is None:
+            shapes[name] = None
+            dtypes[name] = None
+            continue
+        a = np.ascontiguousarray(a)
+        shapes[name] = list(a.shape)
+        dtypes[name] = a.dtype.name
+        bufs.append(a.tobytes())
+    raw = b"".join(bufs)
+    header = json.dumps({
+        "version": _VERSION,
+        "req_id": req_id,
+        "n_tokens": int(n_tokens),
+        "block_size": int(block_size),
+        "quantized": blocks.get("k_scale") is not None,
+        "shapes": shapes,
+        "dtypes": dtypes,
+        "crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+    }).encode("utf-8")
+    return _MAGIC + struct.pack("<I", len(header)) + header + raw
+
+
+def decode_blocks(data: bytes) -> dict:
+    """Parse a payload back into arrays + metadata.
+
+    Raises ``ValueError`` on bad magic, unknown version, truncation, or
+    CRC mismatch — the decode worker maps that to ``fail_handoff`` so a
+    corrupt record dispositions instead of crash-looping a replica.
+
+    Returns ``{"k","v","k_scale","v_scale","req_id","n_tokens",
+    "block_size","quantized"}``.
+    """
+    if len(data) < len(_MAGIC) + 4 or data[: len(_MAGIC)] != _MAGIC:
+        raise ValueError("bad handoff payload: missing magic")
+    (hlen,) = struct.unpack_from("<I", data, len(_MAGIC))
+    body_at = len(_MAGIC) + 4 + hlen
+    if len(data) < body_at:
+        raise ValueError("bad handoff payload: truncated header")
+    try:
+        header = json.loads(data[len(_MAGIC) + 4: body_at])
+    except json.JSONDecodeError as e:
+        raise ValueError(f"bad handoff payload: header not JSON ({e})")
+    if header.get("version") != _VERSION:
+        raise ValueError(
+            f"bad handoff payload: version {header.get('version')!r}"
+        )
+    raw = data[body_at:]
+    if zlib.crc32(raw) & 0xFFFFFFFF != header["crc32"]:
+        raise ValueError("bad handoff payload: CRC mismatch")
+    out = {
+        "req_id": header["req_id"],
+        "n_tokens": header["n_tokens"],
+        "block_size": header["block_size"],
+        "quantized": header["quantized"],
+    }
+    off = 0
+    for name in _ARRAYS:
+        shape = header["shapes"].get(name)
+        if shape is None:
+            out[name] = None
+            continue
+        dt = _dtype_of(header["dtypes"][name])
+        nbytes = int(np.prod(shape)) * dt.itemsize
+        if off + nbytes > len(raw):
+            raise ValueError("bad handoff payload: truncated buffers")
+        out[name] = np.frombuffer(
+            raw, dtype=dt, count=int(np.prod(shape)), offset=off,
+        ).reshape(shape)
+        off += nbytes
+    if off != len(raw):
+        raise ValueError("bad handoff payload: trailing bytes")
+    return out
+
+
+@dataclasses.dataclass
+class HandoffRecord:
+    """One prefilled request in flight between roles: the original
+    request (its delivery budget rides along — re-prefills draw from the
+    same ``max_delivery_attempts``), the prefill-sampled first token,
+    the prompt length, and the opaque serialized KV payload."""
+
+    req: GenerateRequest
+    first_token: int
+    n_tokens: int
+    payload: bytes
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "req": self.req.to_json(),
+            "first_token": self.first_token,
+            "n_tokens": self.n_tokens,
+            "payload_b64": base64.b64encode(self.payload).decode("ascii"),
+        })
+
+    @classmethod
+    def from_json(cls, raw) -> "HandoffRecord":
+        d = json.loads(raw)
+        return cls(
+            req=GenerateRequest.from_json(d["req"]),
+            first_token=int(d["first_token"]),
+            n_tokens=int(d["n_tokens"]),
+            payload=base64.b64decode(d["payload_b64"]),
+        )
+
+
+def pick_decode_worker(
+    workers: dict, handoff_depths: dict | None = None,
+) -> str | None:
+    """Choose a decode-role replica for a fresh handoff: least backlog
+    (in-flight rows + routed handoff depth), free row slots as the
+    tiebreak, lexical id as the stable last resort. ``workers`` is the
+    broker registry view (``read_workers`` — expired entries already
+    purged, so no clock math here); returns None when no ready
+    decode-role worker exists (the caller falls back to the shared
+    handoff queue, which any decode worker drains)."""
+    depths = handoff_depths or {}
+    best = None
+    best_key = None
+    for wid, info in workers.items():
+        if info.get("role") != "decode":
+            continue
+        if info.get("state", STATE_READY) != STATE_READY:
+            continue
+        backlog = (
+            int(info.get("inflight_rows") or 0) + int(depths.get(wid, 0))
+        )
+        key = (backlog, -int(info.get("free_slots") or 0), wid)
+        if best_key is None or key < best_key:
+            best, best_key = wid, key
+    return best
+
+
+class _RoleWorkerBase:
+    """Shared registry/heartbeat plumbing for the minimal role workers."""
+
+    role = "unified"
+
+    def __init__(
+        self, engine, broker, *, worker_id: str | None = None,
+        poll_timeout_s: float = 0.02, snapshot_interval_s: float = 1.0,
+    ):
+        self.engine = engine
+        self.broker = broker
+        self.worker_id = worker_id or uuid.uuid4().hex[:8]
+        self.poll_timeout_s = poll_timeout_s
+        self.snapshot_interval_s = snapshot_interval_s
+        self._last_snapshot = 0.0  # monotonic
+        self._inflight = 0
+        broker.register_worker({
+            "worker_id": self.worker_id,
+            "role": self.role,
+            "model": getattr(engine, "model_name", "scripted"),
+            "max_seq_len": getattr(engine, "max_seq_len", None),
+        })
+        self._publish(force=True)
+
+    def _publish(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_snapshot < self.snapshot_interval_s:
+            return
+        self._last_snapshot = now
+        self.broker.publish_worker_load(self.worker_id, {
+            "worker_id": self.worker_id,
+            "role": self.role,
+            "state": STATE_READY,
+            "inflight_rows": self._inflight,
+            "free_slots": 1 - self._inflight,
+            "queue_depth": 0,
+            # Heartbeat contract is wall-clock by design: readers compare
+            # against their own time.time() across processes.
+            "heartbeat_ts": time.time(),  # lint: ignore[wall-clock-timer]
+            "heartbeat_interval_s": self.snapshot_interval_s,
+        })
+
+
+class PrefillWorker(_RoleWorkerBase):
+    """Minimal prefill-role loop over the engine protocol
+    ``prefill_export(token_ids, max_new_tokens) -> (first_token,
+    payload_bytes)`` (ScriptedEngine implements it; the real stack uses
+    ``ContinuousWorker(role="prefill")`` instead).
+
+    Pops requests, exports, targets the least-loaded decode replica (or
+    the shared handoff queue), and answers max_new<=1 requests locally —
+    shipping KV that will never be decoded is pure overhead.
+
+    ``on_exported(record)`` is the chaos hook: it runs after the export
+    but BEFORE ``push_handoff``, so a HardKill raised there leaves the
+    request lease un-acked — the at-least-once contract must re-prefill
+    it elsewhere with zero loss (tests/test_handoff.py).
+    """
+
+    role = "prefill"
+
+    def __init__(self, engine, broker, *, on_exported=None, **kw):
+        self.on_exported = on_exported
+        super().__init__(engine, broker, **kw)
+
+    def run_once(self) -> int:
+        self._publish()
+        req = self.broker.pop_request(
+            timeout=self.poll_timeout_s, worker_id=self.worker_id,
+        )
+        if req is None:
+            return 0
+        self._inflight = 1
+        self._publish(force=True)
+        try:
+            try:
+                first, payload = self.engine.prefill_export(
+                    list(req.token_ids or []), req.max_new_tokens,
+                )
+            except Exception as e:  # noqa: BLE001 — worker must answer
+                self.broker.push_response(GenerateResponse(
+                    id=req.id, error=f"prefill failed: {e}",
+                ))
+                return 1
+            if req.max_new_tokens <= 1:
+                # Short request: the first token IS the answer — respond
+                # here, bit-identical to a unified worker.
+                self.broker.push_response(GenerateResponse(
+                    id=req.id,
+                    token_ids=[first] if req.max_new_tokens else [],
+                ))
+                return 1
+            rec = HandoffRecord(
+                req=req, first_token=first,
+                n_tokens=len(req.token_ids or []), payload=payload,
+            )
+            if self.on_exported is not None:
+                self.on_exported(rec)  # chaos hook — may HardKill
+            target = pick_decode_worker(
+                self.broker.read_workers(), self.broker.handoff_depths(),
+            )
+            if target is not None:
+                self.broker.push_handoff_to(target, rec)
+            else:
+                self.broker.push_handoff(rec)
+            return 1
+        finally:
+            self._inflight = 0
+            self._publish(force=True)
+
+
+class DecodeWorker(_RoleWorkerBase):
+    """Minimal decode-role loop over the engine protocol
+    ``adopt_generate(payload, max_new_tokens, first_token, n_tokens,
+    on_increment=...) -> full token list`` (ScriptedEngine implements
+    it). Pops handoff records, keeps the handoff lease fresh through
+    ``on_increment``, and answers — ``push_response`` acks the lease.
+    Un-adoptable payloads go back through ``fail_handoff`` (re-prefill /
+    DLQ), never crash the replica."""
+
+    role = "decode"
+
+    def run_once(self) -> int:
+        self._publish()
+        rec = self.broker.pop_handoff(
+            timeout=self.poll_timeout_s, worker_id=self.worker_id,
+        )
+        if rec is None:
+            return 0
+        self._inflight = 1
+        self._publish(force=True)
+        rid = rec.req.id
+        try:
+            try:
+                toks = self.engine.adopt_generate(
+                    rec.payload, rec.req.max_new_tokens, rec.first_token,
+                    rec.n_tokens,
+                    on_increment=lambda: self.broker.touch_handoffs([rid]),
+                )
+            except Exception as e:  # noqa: BLE001 — disposition, don't die
+                self.broker.fail_handoff(rec, error=str(e))
+                return 1
+            self.broker.push_response(GenerateResponse(
+                id=rid, token_ids=list(toks),
+            ))
+            return 1
+        finally:
+            self._inflight = 0
+            self._publish(force=True)

@@ -366,8 +366,8 @@ class ProducerServer:
         if not workers and self.router is None:
             return None
         keys = (
-            "state", "inflight_rows", "queue_depth", "free_kv_blocks",
-            "free_slots", "kv_blocks_total",
+            "role", "state", "inflight_rows", "queue_depth",
+            "free_kv_blocks", "free_slots", "kv_blocks_total",
         )
         out: dict = {
             "workers": {
@@ -375,6 +375,10 @@ class ProducerServer:
                 for wid, info in sorted(workers.items())
             },
             "routed_depths": self.broker.routed_depths(),
+            # Disaggregated prefill/decode: records waiting between a
+            # prefill export and a decode adopt (shared + per-replica).
+            "handoff_depth": self.broker.handoff_depth(),
+            "handoff_depths": self.broker.handoff_depths(),
         }
         if self.router is not None:
             out["router"] = self.router.stats()
@@ -561,8 +565,8 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
         workers = broker.read_workers()
         if workers or router is not None:
             keys = (
-                "state", "inflight_rows", "queue_depth", "free_kv_blocks",
-                "free_slots", "kv_blocks_total",
+                "role", "state", "inflight_rows", "queue_depth",
+                "free_kv_blocks", "free_slots", "kv_blocks_total",
             )
             fleet: dict = {
                 "workers": {
@@ -570,6 +574,8 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
                     for wid, info in sorted(workers.items())
                 },
                 "routed_depths": broker.routed_depths(),
+                "handoff_depth": broker.handoff_depth(),
+                "handoff_depths": broker.handoff_depths(),
             }
             if router is not None:
                 fleet["router"] = router.stats()

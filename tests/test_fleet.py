@@ -27,6 +27,7 @@ from llmss_tpu.serve.fleet import (
     fleet_status,
     routable_workers,
 )
+from llmss_tpu.serve.handoff import HandoffRecord
 from llmss_tpu.serve.producer import ProducerServer, evaluate_fleet_health
 from llmss_tpu.serve.protocol import (
     STATE_DEAD,
@@ -636,3 +637,139 @@ def test_fleet_chaos_kill_mid_decode(kind):
     assert router.stats()["failover_reroutes"] >= len(stranded)
     assert producer.delivery_stats()["failover_rerouted"] >= len(stranded)
     assert "w0" not in router.routable_workers()
+
+
+# -- disaggregated roles ----------------------------------------------------
+
+
+def hrec(i=0, **kw):
+    r = req(i, **kw)
+    return HandoffRecord(
+        req=r, first_token=1, n_tokens=len(r.token_ids), payload=b"kv" * 8,
+    )
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_router_excludes_decode_replicas_from_raw_requests(kind):
+    b, _ = make_brokers(kind)
+    fleet_of(
+        b, "w0", "d0",
+        w0={"inflight_rows": 3, "free_slots": 1},  # busy unified replica
+        d0={"role": "decode"},  # idle decode replica
+    )
+    r = Router(b, "least_loaded")
+    # The idle decode replica NEVER takes a raw request — it only speaks
+    # the handoff channel; a request routed there would strand.
+    assert r.submit(req(0)) == "w0"
+    assert r.submit(req(1)) == "w0"
+    assert "d0" not in r.stats()["routed_by_worker"]
+
+    # A decode-only fleet has no raw-request target at all: shared-queue
+    # fallback (a prefill/unified replica appearing later serves it).
+    b2, _ = make_brokers(kind)
+    fleet_of(b2, "d0", d0={"role": "decode"})
+    r2 = Router(b2, "least_loaded")
+    assert r2.submit(req(2)) is None
+    assert r2.stats()["shared_fallback"] == 1
+    assert b2.routed_depths() == {}
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_fleet_status_shows_roles_and_handoff_depths(kind):
+    b, mk = make_brokers(kind)
+    fleet_of(
+        b, "p0", "d0",
+        p0={"role": "prefill"},
+        d0={"role": "decode"},
+    )
+    routed, shared = hrec(0), hrec(1)
+    b.push_handoff_to("d0", routed)
+    b.push_handoff(shared)
+    st = fleet_status(b, Router(b, "least_loaded"))
+    assert st["workers"]["p0"]["role"] == "prefill"
+    assert st["workers"]["d0"]["role"] == "decode"
+    assert st["workers"]["d0"]["routed_handoff_depth"] == 1
+    assert st["workers"]["d0"]["handoff_leases_held"] == 0
+    assert st["handoff_depth"] == 2  # shared + routed
+
+    # Adoption converts routed depth into a held lease (the routed queue
+    # drains before the shared one, so d0 gets its targeted record).
+    got = mk("d0").pop_handoff(timeout=0.5, worker_id="d0")
+    assert got is not None and got.req.id == routed.req.id
+    st = fleet_status(b, None)
+    assert st["workers"]["d0"]["routed_handoff_depth"] == 0
+    assert st["workers"]["d0"]["handoff_leases_held"] == 1
+    assert st["handoff_depth"] == 1
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_failover_reroutes_handoffs_to_surviving_decode(kind):
+    b, mk = make_brokers(kind)
+    fleet_of(
+        b, "p0", "d0", "d1",
+        p0={"role": "prefill"},
+        d0={"role": "decode", "heartbeat_s": 0.05},
+        d1={"role": "decode"},
+    )
+    # d0 adopted one record (leased) and has one routed-but-unleased.
+    b.push_handoff_to("d0", hrec(0, id="adopted"))
+    db = mk("d0")
+    got = db.pop_handoff(timeout=0.5, worker_id="d0")
+    assert got is not None and got.req.id == "adopted"
+    b.push_handoff_to("d0", hrec(1, id="routed"))
+    time.sleep(0.2)  # d0's heartbeat goes stale; d1 stays fresh
+    r = Router(b, "least_loaded", failover_check_s=0.01)
+    assert r.check_failover(force=True) == 1  # the intact routed record
+    # The routed record (KV payload intact) moved to the surviving
+    # decode replica — no re-prefill for it...
+    assert b.handoff_depths() == {"d1": 1}
+    moved = mk("d1").pop_handoff(timeout=0.5, worker_id="d1")
+    assert moved is not None and moved.req.id == "routed"
+    # ...while the adopted one re-prefills: its device state died with
+    # d0, so the embedded request returns to the shared queue.
+    back = b.pop_request(timeout=0.5)
+    assert back is not None and back.id == "adopted"
+    assert b.delivery_stats()["reprefills"] == 1
+    assert r.stats()["handoff_reroutes"] == 1
+
+
+def test_producer_surfaces_roles_and_handoff_metrics():
+    import http.client
+    import json
+
+    b = InProcBroker()
+    fleet_of(
+        b, "p0", "d0",
+        p0={"role": "prefill"},
+        d0={"role": "decode"},
+    )
+    b.push_handoff_to("d0", hrec(0))
+    router = Router(b, "least_loaded")
+    srv = ProducerServer(b, host="127.0.0.1", port=0, router=router)
+    srv.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        # GET /fleet: per-worker role + handoff depth detail.
+        conn.request("GET", "/fleet")
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 200
+        assert body["workers"]["p0"]["role"] == "prefill"
+        assert body["workers"]["d0"]["role"] == "decode"
+        assert body["workers"]["d0"]["routed_handoff_depth"] == 1
+        assert body["handoff_depth"] == 1
+        # /metrics fleet block: role per worker + handoff queue depths.
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 200
+        fl = body["fleet"]
+        assert fl["workers"]["p0"]["role"] == "prefill"
+        assert fl["workers"]["d0"]["role"] == "decode"
+        assert fl["handoff_depth"] == 1
+        assert fl["handoff_depths"] == {"d0": 1}
+        # The delivery block carries the channel counters.
+        assert body["delivery"]["handoffs"] == 1
+        conn.close()
+    finally:
+        srv.stop()

@@ -1,0 +1,622 @@
+"""KV handoff: wire format, broker channel, role workers, bit-identity.
+
+The disaggregated prefill/decode subsystem (``serve/handoff.py`` +
+``engine/scheduler.py`` prefill-only/adopt + the broker handoff channel)
+ships on three claims, each pinned here:
+
+- the wire format round-trips paged blocks bit-exactly (bf16 and
+  int8+scales) and refuses corrupt payloads loudly;
+- the handoff channel keeps the single-worker delivery contract —
+  exactly one terminal response per request — across handoff lease
+  expiry, un-adoptable payloads, failover, and a prefill replica
+  hard-killed mid-handoff (the acceptance chaos case);
+- a 1-prefill + 1-decode fleet emits token streams bit-identical to a
+  unified worker on the same requests, on both ``InProcBroker`` and
+  ``RedisBroker``-over-``FakeRedis``.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from llmss_tpu.serve.broker import InProcBroker, RedisBroker
+from llmss_tpu.serve.chaos import (
+    ChaosWorkerHost,
+    FakeRedis,
+    HardKill,
+    ScriptedEngine,
+)
+from llmss_tpu.serve.handoff import (
+    DecodeWorker,
+    HandoffRecord,
+    PrefillWorker,
+    decode_blocks,
+    encode_blocks,
+    pick_decode_worker,
+)
+from llmss_tpu.serve.protocol import (
+    STATE_READY,
+    GenerateRequest,
+    GenerateResponse,
+)
+
+BROKER_KINDS = ("inproc", "fakeredis")
+
+
+def make_brokers(kind, **kw):
+    """(producer-side broker, make_worker_broker(worker_id)) pair — the
+    same two deployment shapes tests/test_fleet.py exercises."""
+    if kind == "inproc":
+        b = InProcBroker(**kw)
+        return b, (lambda wid: b)
+    server = FakeRedis()
+
+    def mk(wid):
+        return RedisBroker(client=server, worker_id=wid, **kw)
+
+    return mk("producer"), mk
+
+
+# -- wire format ------------------------------------------------------------
+
+
+def _blocks(nb=3, quantized=False, seed=0):
+    """A synthetic export_blocks dict: [L, nb, bs, Hkv, D] segments
+    (scales [L, nb, bs, Hkv]) in the exact dtypes the paged pool uses."""
+    import ml_dtypes
+
+    rng = np.random.default_rng(seed)
+    shape = (2, nb, 16, 2, 8)
+    if quantized:
+        k = rng.integers(-128, 128, shape, dtype=np.int8)
+        v = rng.integers(-128, 128, shape, dtype=np.int8)
+        ks = rng.standard_normal(shape[:-1], dtype=np.float32)
+        vs = rng.standard_normal(shape[:-1], dtype=np.float32)
+    else:
+        k = rng.standard_normal(shape, np.float32).astype(ml_dtypes.bfloat16)
+        v = rng.standard_normal(shape, np.float32).astype(ml_dtypes.bfloat16)
+        ks = vs = None
+    return {"k": k, "v": v, "k_scale": ks, "v_scale": vs}
+
+
+@pytest.mark.parametrize("quantized", (False, True))
+def test_wire_roundtrip_bit_exact(quantized):
+    blocks = _blocks(quantized=quantized)
+    payload = encode_blocks(blocks, req_id="r1", n_tokens=40, block_size=16)
+    out = decode_blocks(payload)
+    assert out["req_id"] == "r1" and out["n_tokens"] == 40
+    assert out["block_size"] == 16 and out["quantized"] is quantized
+    for name in ("k", "v", "k_scale", "v_scale"):
+        a, b = blocks[name], out[name]
+        if a is None:
+            assert b is None
+            continue
+        assert b.dtype == a.dtype and b.shape == a.shape
+        assert b.tobytes() == a.tobytes()  # BIT-exact, not just close
+
+
+def test_wire_roundtrip_partial_tail_block():
+    # 18 tokens over block_size 16: 2 blocks, the second only 2 slots
+    # live. The slot masking is the exporter's job — the wire just has to
+    # carry n_tokens through so the adopter seeds positions correctly.
+    blocks = _blocks(nb=2)
+    payload = encode_blocks(blocks, req_id="t", n_tokens=18, block_size=16)
+    out = decode_blocks(payload)
+    assert out["n_tokens"] == 18
+    assert out["k"].shape[1] == 2 and out["k"].tobytes() == blocks["k"].tobytes()
+
+
+def test_wire_rejects_corruption():
+    payload = encode_blocks(
+        _blocks(), req_id="r", n_tokens=48, block_size=16,
+    )
+    cases = {
+        "bad magic": b"XKVH" + payload[4:],
+        "unknown version": payload.replace(
+            b'"version": 1', b'"version": 9', 1,
+        ),
+        "truncated header": payload[:6],
+        "truncated buffers": payload[:-3],
+        "flipped buffer byte": (
+            payload[:-1] + bytes([payload[-1] ^ 0x01])
+        ),
+        "trailing bytes": payload + b"\x00",
+    }
+    for name, data in cases.items():
+        with pytest.raises(ValueError):
+            decode_blocks(data)  # noqa: B017 — each case must reject
+    decode_blocks(payload)  # the pristine payload still decodes
+
+
+# -- decode-replica placement ----------------------------------------------
+
+
+def test_pick_decode_worker_least_backlog():
+    ws = {
+        "p0": {"role": "prefill", "state": STATE_READY, "free_slots": 4},
+        "d0": {"role": "decode", "state": STATE_READY,
+               "inflight_rows": 2, "free_slots": 2},
+        "d1": {"role": "decode", "state": STATE_READY,
+               "inflight_rows": 0, "free_slots": 4},
+        "d2": {"role": "decode", "state": "draining",
+               "inflight_rows": 0, "free_slots": 8},
+    }
+    assert pick_decode_worker(ws) == "d1"
+    # Routed handoff depth counts as backlog — d1 stops being best.
+    assert pick_decode_worker(ws, {"d1": 5}) == "d0"
+    # No ready decode replica -> None (caller uses the shared queue).
+    assert pick_decode_worker({"p0": ws["p0"], "d2": ws["d2"]}) is None
+
+
+# -- broker handoff channel -------------------------------------------------
+
+
+def _req(i=0, **kw):
+    kw.setdefault("deadline_ts", time.time() + 60.0)
+    kw.setdefault("max_new_tokens", 4)
+    return GenerateRequest(id=f"h{i}", token_ids=[1, 2, i + 3], **kw)
+
+
+def _rec(req, payload=b"kv-payload"):
+    return HandoffRecord(
+        req=req, first_token=7, n_tokens=len(req.token_ids), payload=payload,
+    )
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_handoff_settles_request_lease_then_acks_on_response(kind):
+    b, mk = make_brokers(kind, lease_s=0.1, max_delivery_attempts=3)
+    pb, db = mk("p0"), mk("d0")
+    b.push_request(_req(0))
+    leased = pb.pop_request(timeout=1.0, worker_id="p0")
+    pb.push_handoff(_rec(leased, payload=b"x" * 32))
+    # The handoff IS the prefill worker's ack: the request lease is
+    # settled, so its expiry never redelivers.
+    time.sleep(0.15)
+    b.reap_expired()
+    assert b.pop_request(timeout=0.01) is None
+    st = b.delivery_stats()
+    assert st["redelivered"] == 0
+    assert st["handoffs"] == 1 and st["handoff_bytes"] == 32
+    assert b.handoff_depth() == 1
+
+    got = db.pop_handoff(timeout=1.0, worker_id="d0")
+    assert got.req.id == "h0" and got.payload == b"x" * 32
+    assert got.first_token == 7 and got.n_tokens == 3
+    assert b.handoff_holders() == {"d0": 1}
+    # push_response acks the handoff lease — no disposition ever runs.
+    db.push_response(GenerateResponse(id="h0", token_ids=[7, 8]))
+    assert b.handoff_holders() == {}
+    time.sleep(0.15)
+    b.reap_expired()
+    resp = b.wait_response("h0", timeout=1.0)
+    assert resp is not None and resp.token_ids == [7, 8]
+    assert b.wait_response("h0", timeout=0.05) is None  # exactly one
+    assert b.delivery_stats()["reprefills"] == 0
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_routed_handoff_targets_one_decode_worker(kind):
+    b, mk = make_brokers(kind)
+    b.push_handoff_to("d1", _rec(_req(0)))
+    assert b.handoff_depths() == {"d1": 1}
+    assert b.handoff_depth() == 1
+    # Another decode worker never sees a routed record.
+    assert mk("d0").pop_handoff(timeout=0.01, worker_id="d0") is None
+    got = mk("d1").pop_handoff(timeout=0.5, worker_id="d1")
+    assert got is not None and got.req.id == "h0"
+    assert b.handoff_depths() == {}
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_handoff_lease_expiry_reprefills(kind):
+    b, mk = make_brokers(kind, lease_s=0.08, max_delivery_attempts=5)
+    b.push_handoff(_rec(_req(0)))
+    assert mk("d0").pop_handoff(timeout=0.5, worker_id="d0") is not None
+    time.sleep(0.15)
+    b.reap_expired()
+    # The decode replica is presumed dead; its adopted KV died with it —
+    # the embedded request goes back to the SHARED queue for a fresh
+    # prefill, counted as a re-prefill (not a redelivery).
+    back = b.pop_request(timeout=0.5)
+    assert back is not None and back.id == "h0"
+    st = b.delivery_stats()
+    assert st["reprefills"] == 1 and st["redelivered"] == 0
+    assert b.handoff_holders() == {}
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_touch_handoffs_keeps_lease_alive(kind):
+    b, mk = make_brokers(kind, lease_s=0.12)
+    db = mk("d0")
+    b.push_handoff(_rec(_req(0)))
+    assert db.pop_handoff(timeout=0.5, worker_id="d0") is not None
+    for _ in range(4):  # 4 * 0.06 = 2x the lease, renewed per "chunk"
+        time.sleep(0.06)
+        db.touch_handoffs(["h0"])
+        b.reap_expired()
+    assert b.handoff_holders() == {"d0": 1}  # never dispositioned
+    db.push_response(GenerateResponse(id="h0", token_ids=[7]))
+    assert b.delivery_stats()["reprefills"] == 0
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_fail_handoff_reprefills_then_dead_letters(kind):
+    b, mk = make_brokers(kind, lease_s=5.0, max_delivery_attempts=2)
+    pb, db = mk("p0"), mk("d0")
+    b.push_request(_req(0))
+    for attempt in (1, 2):
+        req = pb.pop_request(timeout=1.0, worker_id="p0")
+        assert req is not None and req.delivery_attempts == attempt
+        pb.push_handoff(_rec(req))
+        rec = db.pop_handoff(timeout=1.0, worker_id="d0")
+        db.fail_handoff(rec, error="corrupt payload")
+    # Attempt 1 re-prefilled; attempt 2 exhausted the budget.
+    st = b.delivery_stats()
+    assert st["reprefills"] == 1 and st["dead_lettered"] == 1
+    assert b.dlq_depth() == 1
+    resp = b.wait_response("h0", timeout=1.0)
+    assert resp is not None and "dead-lettered" in resp.error
+    assert b.wait_response("h0", timeout=0.05) is None  # exactly one
+    assert b.pop_request(timeout=0.01) is None
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_handoff_deadline_sheds_terminally(kind):
+    b, mk = make_brokers(kind, lease_s=0.05, max_delivery_attempts=5)
+    b.push_handoff(_rec(_req(0, deadline_ts=time.time() + 0.1)))
+    assert mk("d0").pop_handoff(timeout=0.5, worker_id="d0") is not None
+    time.sleep(0.2)  # lease AND end-to-end deadline both pass
+    b.reap_expired()
+    resp = b.wait_response("h0", timeout=1.0)
+    assert resp is not None and "deadline" in resp.error
+    assert b.pop_request(timeout=0.01) is None  # shed, not re-prefilled
+    st = b.delivery_stats()
+    assert st["deadline_expired"] == 1 and st["reprefills"] == 0
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_failover_handoffs_splits_routed_from_leased(kind):
+    b, mk = make_brokers(kind, lease_s=60.0)
+    # One adopted (leased) record: the device state dies with d0.
+    b.push_handoff(_rec(_req(0)))
+    got = mk("d0").pop_handoff(timeout=0.5, worker_id="d0")
+    assert got is not None and got.req.id == "h0"
+    # Two routed-but-unleased records: payload intact, re-routable.
+    b.push_handoff_to("d0", _rec(_req(1)))
+    b.push_handoff_to("d0", _rec(_req(2)))
+    assert b.handoff_depths() == {"d0": 2}
+    assert b.handoff_holders() == {"d0": 1}
+
+    moved = b.failover_handoffs("d0")
+    assert sorted(m.req.id for m in moved) == ["h1", "h2"]
+    back = b.pop_request(timeout=0.5)
+    assert back is not None and back.id == "h0"  # re-prefill
+    assert b.delivery_stats()["reprefills"] == 1
+    assert b.handoff_depths() == {} and b.handoff_holders() == {}
+
+
+# -- role workers over ScriptedEngine ---------------------------------------
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_role_workers_end_to_end(kind):
+    b, mk = make_brokers(kind, lease_s=2.0)
+    pre = PrefillWorker(ScriptedEngine(), mk("p0"), worker_id="p0")
+    dec = DecodeWorker(ScriptedEngine(), mk("d0"), worker_id="d0")
+    reqs = [
+        GenerateRequest(
+            id=f"r{i}", token_ids=[10 + i, 20 + i], max_new_tokens=5,
+        )
+        for i in range(4)
+    ] + [GenerateRequest(id="s", token_ids=[7], max_new_tokens=1)]
+    for r in reqs:
+        b.push_request(r)
+    got = {}
+    deadline = time.monotonic() + 20
+    while len(got) < len(reqs) and time.monotonic() < deadline:
+        pre.run_once()
+        dec.run_once()
+        for r in reqs:
+            if r.id not in got:
+                resp = b.wait_response(r.id, timeout=0.01)
+                if resp is not None:
+                    got[r.id] = resp
+    assert len(got) == len(reqs)
+    for r in reqs:
+        assert got[r.id].error is None, (r.id, got[r.id].error)
+        assert got[r.id].token_ids == ScriptedEngine.expected_tokens(
+            list(r.token_ids), r.max_new_tokens,
+        )
+    ws = b.read_workers()
+    assert ws["p0"]["role"] == "prefill" and ws["d0"]["role"] == "decode"
+    st = b.delivery_stats()
+    # The max_new=1 request answered locally on the prefill replica.
+    assert st["handoffs"] == 4 and st["reprefills"] == 0
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_chaos_kill_prefill_mid_handoff_exactly_one_terminal(kind):
+    """The acceptance chaos case: the prefill replica hard-dies AFTER
+    exporting but BEFORE push_handoff. The request lease is still open,
+    so at-least-once redelivery re-prefills it on the respawned replica —
+    zero requests lost, zero double-answered."""
+    b, mk = make_brokers(kind, lease_s=0.25, max_delivery_attempts=6)
+    kills_left = [2]
+    klock = threading.Lock()
+
+    def on_exported(rec):
+        with klock:
+            if kills_left[0] > 0:
+                kills_left[0] -= 1
+                raise HardKill(f"killed after exporting {rec.req.id}")
+
+    pre = ChaosWorkerHost(
+        lambda: PrefillWorker(
+            ScriptedEngine(), mk("p0"), worker_id="p0",
+            on_exported=on_exported, poll_timeout_s=0.02,
+        ),
+        respawn_delay_s=0.02,
+    )
+    dec = ChaosWorkerHost(
+        lambda: DecodeWorker(
+            ScriptedEngine(), mk("d0"), worker_id="d0",
+            poll_timeout_s=0.02,
+        ),
+        respawn_delay_s=0.02,
+    )
+    reqs = [
+        GenerateRequest(
+            id=f"r{i}", token_ids=[i % 50 + 1, i % 7 + 1],
+            max_new_tokens=4, deadline_ts=time.time() + 30.0,
+        )
+        for i in range(10)
+    ]
+    pre.start()
+    dec.start()
+    try:
+        for r in reqs:
+            b.push_request(r)
+        for r in reqs:
+            resp = b.wait_response(r.id, timeout=20.0)
+            assert resp is not None, f"lost {r.id}"
+            assert resp.error is None, (r.id, resp.error)
+            assert resp.token_ids == ScriptedEngine.expected_tokens(
+                list(r.token_ids), r.max_new_tokens,
+            ), r.id
+            # A double answer would park a second response under the id.
+            assert b.wait_response(r.id, timeout=0.05) is None, (
+                f"duplicate terminal response for {r.id}"
+            )
+    finally:
+        pre.stop()
+        dec.stop()
+    assert pre.error is None and dec.error is None
+    assert pre.kills == 2 and pre.spawns >= 3
+    # The two killed exports came back via request-lease redelivery.
+    assert b.delivery_stats()["redelivered"] >= 2
+
+
+# -- real-engine bit-identity ----------------------------------------------
+
+
+import jax  # noqa: E402
+
+from llmss_tpu.engine import DecodeEngine, GenerationParams  # noqa: E402
+from llmss_tpu.engine.scheduler import ContinuousBatcher  # noqa: E402
+from llmss_tpu.models.common import DecoderConfig  # noqa: E402
+from llmss_tpu.models.decoder import init_params  # noqa: E402
+from llmss_tpu.parallel import MeshPlan, make_mesh  # noqa: E402
+from llmss_tpu.serve.consumer import ContinuousWorker  # noqa: E402
+
+
+def _cfg():
+    return DecoderConfig(
+        model_type="llama", vocab_size=64, hidden_size=32, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=8, intermediate_size=64,
+        max_position_embeddings=64, activation="silu", norm="rmsnorm",
+        norm_eps=1e-5, mlp="swiglu", positions="rotary", rope_style="half",
+        rotary_dim=8, attn_bias=False, mlp_bias=False,
+        tie_word_embeddings=False, dtype="float32",
+    )
+
+
+@pytest.fixture(scope="module")
+def setup():
+    mesh = make_mesh(MeshPlan(dp=2, tp=4))
+    cfg = _cfg()
+    params = init_params(cfg, mesh, jax.random.key(0))
+    return cfg, mesh, params
+
+
+@pytest.fixture(scope="module")
+def paged_engine(setup):
+    cfg, mesh, params = setup
+    return DecodeEngine(
+        cfg, params, mesh, max_seq_len=64, kv_layout="paged", block_size=16,
+    )
+
+
+@pytest.fixture(scope="module")
+def dense_engine(setup):
+    cfg, mesh, params = setup
+    return DecodeEngine(cfg, params, mesh, max_seq_len=64)
+
+
+# Greedy, seed-stateful sampled, partial tail block (18 > block_size),
+# and a max_new=1 row the prefill replica must answer locally.
+_PROMPTS = [[1, 2, 3, 4, 5], list(range(1, 19)), [7, 8, 9]]
+_GENS = [
+    GenerationParams(max_new_tokens=8, is_greedy=True),
+    GenerationParams(max_new_tokens=6, temperature=0.8, top_k=20, seed=3),
+    GenerationParams(max_new_tokens=1, is_greedy=True),
+]
+
+
+def _unified_reference(engine):
+    uni = ContinuousBatcher(engine, rows=2)
+    expected = {}
+    for i, (p, g) in enumerate(zip(_PROMPTS, _GENS)):
+        uni.submit(p, g, lambda toks, i=i: expected.__setitem__(i, toks))
+    uni.run_until_idle()
+    return expected
+
+
+def _export_adopt_roundtrip(engine):
+    """prefill-only export -> wire round-trip -> adopt on a second
+    batcher; returns {index: tokens} merged with locally answered rows."""
+    pre = ContinuousBatcher(engine, rows=2, prefill_only=True)
+    exports, results = {}, {}
+    pre.export_cb = lambda rid, first, n, blocks: exports.__setitem__(
+        rid, (first, n, blocks),
+    )
+    for i, (p, g) in enumerate(zip(_PROMPTS, _GENS)):
+        pre.submit(
+            p, g, lambda toks, i=i: results.__setitem__(i, toks),
+            req_id=str(i),
+        )
+    pre.run_until_idle()
+    assert pre.allocator.blocks_in_use == 0  # exported rows fully released
+
+    dec = ContinuousBatcher(engine, rows=2)
+    for rid, (first, n, blocks) in exports.items():
+        payload = encode_blocks(
+            blocks, req_id=rid, n_tokens=n, block_size=engine.block_size,
+        )
+        d = decode_blocks(payload)
+        ok = dec.adopt(
+            rid, first, n,
+            {k: d[k] for k in ("k", "v", "k_scale", "v_scale")},
+            _GENS[int(rid)],
+            lambda toks, rid=rid: results.__setitem__(int(rid), toks),
+        )
+        assert ok, rid
+    dec.run_until_idle()
+    return results
+
+
+def test_export_adopt_bit_identical(paged_engine):
+    expected = _unified_reference(paged_engine)
+    results = _export_adopt_roundtrip(paged_engine)
+    for i in range(len(_PROMPTS)):
+        assert results[i] == expected[i], (i, results[i], expected[i])
+
+
+def test_export_adopt_bit_identical_int8(setup):
+    cfg, mesh, params = setup
+    engine = DecodeEngine(
+        cfg, params, mesh, max_seq_len=64, kv_layout="paged",
+        block_size=16, kv_dtype="int8",
+    )
+    expected = _unified_reference(engine)
+    results = _export_adopt_roundtrip(engine)
+    for i in range(len(_PROMPTS)):
+        assert results[i] == expected[i], (i, results[i], expected[i])
+
+
+def test_cow_refcounts_preserved_on_export(paged_engine, dense_engine):
+    """Exporting rows whose prompt rides a COW-shared prefix must not
+    disturb the prefix registry: export is a pure pool read, row release
+    decrefs only the rows' own references, and the adopted rows still
+    emit the dense engine's exact tokens."""
+    pfx_tokens = list(range(1, 21))  # 1 full block (bs=16) + tail
+    pfx = paged_engine.build_prefix(pfx_tokens)
+    gen = GenerationParams(max_new_tokens=5, is_greedy=True)
+    full = [pfx_tokens + [30 + i] for i in range(2)]
+    expected = [dense_engine.generate([p], gen)[0] for p in full]
+
+    pre = ContinuousBatcher(paged_engine, rows=2, prefill_only=True)
+    exports = {}
+    pre.export_cb = lambda rid, first, n, blocks: exports.__setitem__(
+        rid, (first, n, blocks),
+    )
+    for i, p in enumerate(full):
+        pre.submit(p, gen, lambda t: None, req_id=str(i), prefix=pfx)
+    pre.run_until_idle()
+    # Only the prefix registry's shared block remains resident — the
+    # exported rows' owned blocks are freed, the shared one survives.
+    assert pre.allocator.blocks_in_use == 1
+    assert len(exports) == 2
+
+    dec = ContinuousBatcher(paged_engine, rows=2)
+    results = {}
+    for rid, (first, n, blocks) in exports.items():
+        assert n == len(pfx_tokens) + 1
+        payload = encode_blocks(
+            blocks, req_id=rid, n_tokens=n, block_size=16,
+        )
+        d = decode_blocks(payload)
+        ok = dec.adopt(
+            rid, first, n,
+            {k: d[k] for k in ("k", "v", "k_scale", "v_scale")},
+            gen, lambda t, rid=rid: results.__setitem__(int(rid), t),
+        )
+        assert ok, rid
+    dec.run_until_idle()
+    for i, e in enumerate(expected):
+        assert results[i] == e, (i, results[i], e)
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_two_replica_fleet_bit_identical_to_unified(kind, paged_engine):
+    """The acceptance criterion: 1 prefill + 1 decode ContinuousWorker
+    replicas produce byte-for-byte the unified worker's responses."""
+    reqs = [
+        GenerateRequest(
+            id="a", token_ids=[1, 2, 3, 4, 5], max_new_tokens=8,
+            is_greedy=True,
+        ),
+        GenerateRequest(
+            id="b", token_ids=list(range(1, 19)), max_new_tokens=6,
+            temperature=0.8, top_k=20, seed=3, is_greedy=False,
+        ),
+        GenerateRequest(
+            id="c", token_ids=[7, 8, 9], max_new_tokens=1, is_greedy=True,
+        ),
+    ]
+
+    def collect(broker, workers):
+        got = {}
+        deadline = time.monotonic() + 60
+        while len(got) < len(reqs) and time.monotonic() < deadline:
+            for w in workers:
+                w.run_once()
+            for r in reqs:
+                if r.id not in got:
+                    resp = broker.wait_response(r.id, timeout=0.01)
+                    if resp is not None:
+                        got[r.id] = resp
+        assert len(got) == len(reqs), sorted(got)
+        for r in reqs:
+            assert got[r.id].error is None, (r.id, got[r.id].error)
+        return {rid: resp.token_ids for rid, resp in got.items()}
+
+    b1, mk1 = make_brokers(kind)
+    uni = ContinuousWorker(
+        paged_engine, mk1("u0"), rows=2, worker_id="u0",
+    )
+    for r in reqs:
+        b1.push_request(r)
+    expected = collect(b1, [uni])
+
+    b2, mk2 = make_brokers(kind)
+    pre = ContinuousWorker(
+        paged_engine, mk2("p0"), rows=2, worker_id="p0", role="prefill",
+    )
+    dec = ContinuousWorker(
+        paged_engine, mk2("d0"), rows=2, worker_id="d0", role="decode",
+    )
+    for r in reqs:
+        b2.push_request(r)
+    got = collect(b2, [pre, dec])
+    assert got == expected
+
+    st = b2.delivery_stats()
+    # "c" (max_new=1) answers on the prefill replica — 2 handoffs, all
+    # settled (nothing in flight, nothing re-prefilled).
+    assert st["handoffs"] == 2 and st["reprefills"] == 0
+    assert st["handoff_inflight"] == 0 and st["handoff_depth"] == 0
+    ws = b2.read_workers()
+    assert ws["p0"]["role"] == "prefill" and ws["d0"]["role"] == "decode"

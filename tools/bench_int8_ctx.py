@@ -170,6 +170,9 @@ def main():
     }
     out["headline"] = result
     print(json.dumps(result))
+    from bench import bench_provenance
+
+    out["provenance"] = bench_provenance()
     with open(os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "INT8_BENCH.json"), "w") as f:
         json.dump(out, f, indent=1)

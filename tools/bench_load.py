@@ -196,6 +196,9 @@ def main() -> None:
         },
     }
     print(json.dumps({k: out[k] for k in ("metric", "value", "unit")}))
+    from bench import bench_provenance
+
+    out["provenance"] = bench_provenance()
     repo = Path(__file__).resolve().parent.parent
     with open(repo / "LOAD_BENCH.json", "w") as f:
         json.dump(out, f, indent=1)

@@ -85,9 +85,11 @@ def main():
         "vs_baseline": round(ttft_scratch / max(ttft_reused, 1e-9), 2),
     }
     print(json.dumps(result))
+    from bench import bench_provenance
+
     with open(os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "PREFIX_BENCH.json"), "w") as f:
-        json.dump(result, f, indent=1)
+        json.dump({**result, "provenance": bench_provenance()}, f, indent=1)
 
 
 if __name__ == "__main__":

@@ -199,6 +199,9 @@ def main():
         "shared": shared,
         "affinity": affinity,
     }
+    from bench import bench_provenance
+
+    result["provenance"] = bench_provenance()
     # The claims the policy ships on: strictly better prefix locality, no
     # TTFT regression.
     assert affinity["prefix_hit_rate"] > shared["prefix_hit_rate"], result

@@ -132,6 +132,8 @@ def main():
     print(json.dumps(result))
     with open(os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "SPEC_BENCH.json"), "w") as f:
+        from bench import bench_provenance
+
         json.dump({**result, "spec_stats": stats,
                    "plain_s": round(t_plain, 2),
                    "spec_s": round(t_spec, 2),
@@ -139,7 +141,8 @@ def main():
                    # the grouped dispatch pays ONE packed fetch per group,
                    # so spec verify loops dominate host_syncs here.
                    "host_overhead": host_overhead_breakdown(
-                       engine.metrics)}, f, indent=1)
+                       engine.metrics),
+                   "provenance": bench_provenance()}, f, indent=1)
 
 
 if __name__ == "__main__":

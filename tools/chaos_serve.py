@@ -40,9 +40,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from llmss_tpu.serve.broker import InProcBroker, RedisBroker  # noqa: E402
 from llmss_tpu.serve.chaos import (  # noqa: E402
     NAN_TOKEN, POISON_TOKEN, ChaosBroker, ChaosWorkerHost, FakeRedis,
-    ScriptedEngine,
+    HardKill, ScriptedEngine,
 )
 from llmss_tpu.serve.consumer import Worker  # noqa: E402
+from llmss_tpu.serve.handoff import DecodeWorker, PrefillWorker  # noqa: E402
 from llmss_tpu.serve.protocol import GenerateRequest  # noqa: E402
 from llmss_tpu.serve.supervisor import Supervisor  # noqa: E402
 
@@ -224,6 +225,124 @@ def run_fault(args):
     return 1 if violations else 0
 
 
+def run_kill_mid_handoff(args):
+    """Disaggregated prefill/decode chaos (``--fault kill-mid-handoff``).
+
+    One prefill replica + one decode replica over the broker's KV handoff
+    channel. The prefill replica is hard-killed AFTER exporting a
+    request's KV but BEFORE pushing the handoff record — the narrowest
+    loss window in the disaggregated path. Because ``push_handoff`` is
+    what settles the request lease, a death in that window leaves the
+    lease un-acked: the visibility timeout must redeliver the request to
+    the respawned prefill replica (a re-prefill), and the audit fails the
+    process if any request was lost, double-answered, or answered with
+    the wrong payload.
+    """
+    args.workers = 2
+    prod_broker, (pb, db) = build_brokers(args)
+
+    kills_left = [args.kills]
+    klock = threading.Lock()
+
+    def on_exported(rec):
+        with klock:
+            if kills_left[0] > 0:
+                kills_left[0] -= 1
+                raise HardKill(
+                    f"chaos: killed after exporting {rec.req.id}, "
+                    "before push_handoff"
+                )
+
+    pre_host = ChaosWorkerHost(
+        lambda: PrefillWorker(
+            ScriptedEngine(), pb, worker_id="prefill0",
+            on_exported=on_exported, poll_timeout_s=0.02,
+        ),
+        respawn_delay_s=0.02,
+    )
+    dec_host = ChaosWorkerHost(
+        lambda: DecodeWorker(
+            ScriptedEngine(), db, worker_id="decode0", poll_timeout_s=0.02,
+        ),
+        respawn_delay_s=0.02,
+    )
+
+    reqs = [
+        GenerateRequest(
+            token_ids=[i % 1000 + 1, i % 7 + 1], max_new_tokens=4,
+            deadline_ts=time.time() + args.deadline_s,
+        )
+        for i in range(args.requests)
+    ]
+    for r in reqs:
+        prod_broker.push_request(r)
+    pre_host.start()
+    dec_host.start()
+
+    results: dict[str, object] = {}
+    lock = threading.Lock()
+
+    def wait_one(req):
+        resp = prod_broker.wait_response(req.id, timeout=args.deadline_s)
+        with lock:
+            results[req.id] = resp
+        dup = prod_broker.wait_response(req.id, timeout=0.2)
+        if dup is not None:
+            with lock:
+                results[req.id] = "DUPLICATE"
+
+    waiters = [
+        threading.Thread(target=wait_one, args=(r,), daemon=True)
+        for r in reqs
+    ]
+    for t in waiters:
+        t.start()
+    for t in waiters:
+        t.join(timeout=args.deadline_s + 5)
+    pre_host.stop()
+    dec_host.stop()
+
+    lost, dup, wrong, ok, errored = [], [], [], 0, 0
+    for r in reqs:
+        got = results.get(r.id)
+        if got is None:
+            lost.append(r.id)
+        elif got == "DUPLICATE":
+            dup.append(r.id)
+        elif got.error:
+            errored += 1
+        elif got.token_ids != ScriptedEngine.expected_tokens(
+            list(r.token_ids), r.max_new_tokens
+        ):
+            wrong.append(r.id)
+        else:
+            ok += 1
+
+    stats = prod_broker.delivery_stats()
+    report = {
+        "fault": "kill-mid-handoff",
+        "requests": args.requests,
+        "ok": ok,
+        "errored": errored,
+        "lost": len(lost),
+        "duplicates": len(dup),
+        "wrong_payload": len(wrong),
+        "prefill_kills": pre_host.kills,
+        "handoffs": stats.get("handoffs"),
+        "reprefills": stats.get("reprefills"),
+        "delivery": stats,
+        "host_errors": [
+            h.error for h in (pre_host, dec_host) if h.error
+        ],
+    }
+    print(json.dumps(report))
+    violations = bool(
+        lost or dup or wrong or errored or report["host_errors"]
+    )
+    violations |= pre_host.kills < args.kills  # the fault must have fired
+    return 1 if violations else 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         "chaos_serve", description=__doc__.split("\n")[0]
@@ -245,11 +364,18 @@ def main(argv=None):
     p.add_argument("--deadline-s", type=float, default=60.0,
                    help="end-to-end deadline stamped on every request")
     p.add_argument("--batch-size", type=int, default=1)
-    p.add_argument("--fault", choices=("drain", "hang", "nan"), default=None,
-                   help="run a deterministic single-worker lifecycle "
-                        "scenario instead of the random kill/drop fleet")
+    p.add_argument("--fault",
+                   choices=("drain", "hang", "nan", "kill-mid-handoff"),
+                   default=None,
+                   help="run a deterministic scripted-failure scenario "
+                        "instead of the random kill/drop fleet")
+    p.add_argument("--kills", type=int, default=3,
+                   help="kill-mid-handoff: how many exports get the "
+                        "prefill replica killed before push_handoff")
     args = p.parse_args(argv)
 
+    if args.fault == "kill-mid-handoff":
+        return run_kill_mid_handoff(args)
     if args.fault is not None:
         return run_fault(args)
 
