@@ -27,6 +27,7 @@ RULES = {
     "jit-dynamic-static-args": "non-literal static_argnums/static_argnames",
     "jit-missing-donate": "cache-threading jit without donate_argnums",
     "wall-clock-timer": "time.time() used for a duration/timeout",
+    "span-not-ended": "start_span() discarded or not ended on all paths",
     "unguarded-write": "write to a `# guarded_by:` attr outside its lock",
     "lock-order-cycle": "cycle in the lock-acquisition-order graph",
 }

@@ -25,8 +25,13 @@ Rules emitted here:
 ``jit-missing-donate``     jit threading a KV ``cache`` parameter without
                            ``donate_argnums``
 ``wall-clock-timer``       ``time.time()`` where a duration/timeout is being
-                           measured (statements touching the cross-process
-                           ``deadline_ts`` are exempt)
+                           measured (statements touching an exempted
+                           cross-process anchor — ``deadline_ts``,
+                           ``wall_anchor`` — are allowed)
+``span-not-ended``         a ``start_span(...)`` call whose span is discarded
+                           or never ``.end()``-ed on a guaranteed path (use
+                           the context manager, or ``end()`` in a
+                           ``finally``)
 """
 
 from __future__ import annotations
@@ -44,6 +49,11 @@ _SYNC_BUILTINS = {"float", "int", "bool"}
 _NP_SYNC_FUNCS = {"asarray", "array"}
 #: Attribute reads that yield *static* (trace-time) values, breaking taint.
 _STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding", "name"}
+
+#: Names whose presence in a statement exempts it from wall-clock-timer:
+#: each is a deliberate cross-process absolute-time anchor (see
+#: ``_check_wall_clock``). A wall-clock read anywhere else is a bug.
+_WALL_EXEMPT = frozenset({"deadline_ts", "wall_anchor"})
 
 
 # --------------------------------------------------------------------------
@@ -641,22 +651,120 @@ class _ModuleChecker(ast.NodeVisitor):
         ) or (isinstance(func, ast.Name) and func.id in self.al.time_funcs)
         if not is_wall:
             return
-        # wall clock is legal only for the cross-process request deadline:
-        # any statement mentioning `deadline_ts` is exempt.
+        # Wall clock is legal only where two processes must agree on an
+        # absolute time — the exemption table names those anchors: the
+        # cross-process request deadline, and the flight recorder's ONE
+        # per-export wall stamp (all trace durations stay monotonic; the
+        # anchor alone converts them at stitch time). Any statement
+        # mentioning an exempted name is allowed.
         stmt = self._enclosing_stmt(node)
         for sub in ast.walk(stmt):
-            if isinstance(sub, ast.Attribute) and sub.attr == "deadline_ts":
+            if isinstance(sub, ast.Attribute) and sub.attr in _WALL_EXEMPT:
                 return
-            if isinstance(sub, ast.Name) and sub.id == "deadline_ts":
+            if isinstance(sub, ast.Name) and sub.id in _WALL_EXEMPT:
                 return
-            if isinstance(sub, ast.Constant) and sub.value == "deadline_ts":
+            if isinstance(sub, ast.Constant) and sub.value in _WALL_EXEMPT:
                 return
         self._flag(
             node, "wall-clock-timer",
             "time.time() measures wall clock, which steps under NTP — use "
             "time.monotonic() for durations/timeouts (wall clock is legal "
-            "only for the cross-process `deadline_ts`)",
+            "only for the cross-process anchors "
+            f"{', '.join(sorted(_WALL_EXEMPT))})",
         )
+
+
+# --------------------------------------------------------------------------
+# span-not-ended
+# --------------------------------------------------------------------------
+
+def _is_start_span(call: ast.AST) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    f = call.func
+    return (
+        isinstance(f, ast.Attribute) and f.attr == "start_span"
+    ) or (isinstance(f, ast.Name) and f.id == "start_span")
+
+
+def _iter_guaranteed(body: list[ast.stmt]):
+    """Statements guaranteed to execute when ``body`` is entered and runs
+    to completion: the body's own statements, descending into ``finally``
+    blocks and ``with`` bodies — but NOT into ``if``/``for``/``while``/
+    ``try`` bodies, which may not run (or not run to the end)."""
+    for stmt in body:
+        yield stmt
+        if isinstance(stmt, ast.Try):
+            yield from _iter_guaranteed(stmt.finalbody)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            yield from _iter_guaranteed(stmt.body)
+
+
+def _ends_span(stmt: ast.stmt, name: str) -> bool:
+    """``stmt`` is a simple statement calling ``<name>.end(...)``."""
+    if not isinstance(stmt, (ast.Expr, ast.Assign, ast.Return)):
+        return False
+    for sub in ast.walk(stmt):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr == "end"
+            and isinstance(sub.func.value, ast.Name)
+            and sub.func.value.id == name
+        ):
+            return True
+    return False
+
+
+class _SpanChecker(ast.NodeVisitor):
+    """A span left open never records its duration — the request's
+    timeline silently loses the phase. Flag ``start_span`` calls whose
+    result is discarded, or bound to a name with no ``.end()`` in a
+    guaranteed-execution position afterwards. ``with start_span(...)``
+    is the blessed form (``Span.__exit__`` always ends; exceptions get an
+    ``error`` attr); so is returning the span to the caller."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: list[Finding] = []
+
+    def _check_body(self, body: list[ast.stmt]) -> None:
+        for idx, stmt in enumerate(body):
+            if isinstance(stmt, ast.Expr) and _is_start_span(stmt.value):
+                self.findings.append(Finding(
+                    "span-not-ended", self.path, stmt.lineno,
+                    stmt.col_offset,
+                    "start_span(...) result discarded — the span can never "
+                    "be ended; use `with ...start_span(...)` or bind and "
+                    "`.end()` it",
+                ))
+            elif (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and _is_start_span(stmt.value)
+            ):
+                name = stmt.targets[0].id
+                if not any(
+                    _ends_span(s, name)
+                    for s in _iter_guaranteed(body[idx + 1:])
+                ):
+                    self.findings.append(Finding(
+                        "span-not-ended", self.path, stmt.lineno,
+                        stmt.col_offset,
+                        f"span `{name}` has no `.end()` on a guaranteed "
+                        "path — end it in a `finally` or use the context "
+                        "manager",
+                    ))
+
+    def generic_visit(self, node: ast.AST) -> None:
+        for field in ("body", "orelse", "finalbody"):
+            body = getattr(node, field, None)
+            if isinstance(body, list) and body and (
+                isinstance(body[0], ast.stmt)
+            ):
+                self._check_body(body)
+        super().generic_visit(node)
 
 
 def check_module(
@@ -665,6 +773,10 @@ def check_module(
     """Run every JAX rule over one module."""
     al = collect_aliases(tree)
     findings = _ModuleChecker(path, al, reg).check(tree)
+
+    span_checker = _SpanChecker(path)
+    span_checker.visit(tree)
+    findings.extend(span_checker.findings)
 
     # analyse jitted function bodies defined in this module
     seen: set[tuple[str, int]] = set()

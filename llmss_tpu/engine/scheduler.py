@@ -58,6 +58,7 @@ from llmss_tpu.engine.cache import (
     table_sentinel,
 )
 from llmss_tpu.engine.engine import DecodeEngine, GenerationParams, _bucket
+from llmss_tpu.utils import trace
 
 
 @dataclasses.dataclass
@@ -656,6 +657,9 @@ class ContinuousBatcher:
                 (req_id, list(token_ids), gen, done_cb, stream_cb,
                  time.perf_counter(), prefix)
             )
+            depth = len(self.pending)
+        if req_id:
+            trace.record(req_id, "sched_submit", queued=depth)
 
     # -- scheduling ---------------------------------------------------------
 
@@ -850,6 +854,14 @@ class ContinuousBatcher:
             # for its first token.
             self.engine.metrics.ttft.record(now - r.t_submit)
             self.engine.metrics.add_request(1)
+            if r.req_id:
+                # "admit" (not "prefill"): its duration is submit→first
+                # token — queue wait + prefill + overlapped chunk — while
+                # the role worker's "prefill" span times only the export
+                # call; distinct names keep phase sums from double-counting.
+                trace.record(
+                    r.req_id, "admit", dur_s=now - r.t_submit
+                )
             r.awaiting_first = False
             n += 1
             first = int(firsts[i])
@@ -1020,6 +1032,8 @@ class ContinuousBatcher:
         self._row_pos[row] = n_tokens
         eng.metrics.add_request(1)
         eng.metrics.add_tokens(1)
+        if req_id:
+            trace.record(req_id, "adopt", n_tokens=n_tokens, row=row)
         if len(r.out) >= gen.max_new_tokens:
             self._finish(row, r)
         else:
@@ -1036,6 +1050,14 @@ class ContinuousBatcher:
         with self._lock:
             self._free.append(row)
         self._flush_stream(r)
+        if r.req_id:
+            trace.record(
+                r.req_id, "finish", tokens=len(r.out),
+                disposition=(
+                    "error" if error is not None
+                    else "cancelled" if cancelled else "served"
+                ),
+            )
         if error is not None:
             # Keyword-only on the error path: existing 2-positional-arg
             # callbacks (tests, batch worker) never see it, and a callback
@@ -1192,6 +1214,14 @@ class ContinuousBatcher:
         with self.engine.metrics.host_fetch.time():
             flat = np.asarray(group.packed)  # the ONE blocking fetch
         self.engine.metrics.add_host_sync()
+        for r in self.active.values():
+            if r.req_id and not r.awaiting_first:
+                # Throttled + sheddable (``group_`` prefix): per-group
+                # cadence would otherwise dominate a long request's ring.
+                trace.record(
+                    r.req_id, "group_fetch", throttle_s=0.05,
+                    chunks=group.n_chunks, k=group.k,
+                )
         toks_np = flat[: nc * R * k].reshape(nc, R, k)
         poisoned_np = flat[nc * R * k:].reshape(nc, R).astype(bool)
         now = time.perf_counter()
@@ -1324,6 +1354,12 @@ class ContinuousBatcher:
             pass
         self.engine.metrics.host_dispatch.record(time.perf_counter() - t0)
         self.engine.metrics.add_group()
+        for r in self.active.values():
+            if r.req_id and not r.awaiting_first:
+                trace.record(
+                    r.req_id, "group_dispatch", throttle_s=0.05,
+                    chunks=nc, k=k,
+                )
         # The admission dispatched LAST step sits between the previous
         # group and this one on the device queue, so this group's
         # fetch-to-fetch interval includes its prefill+insert+merge time.

@@ -40,6 +40,7 @@ import threading
 import time
 
 from llmss_tpu.serve.protocol import GenerateRequest, GenerateResponse
+from llmss_tpu.utils import trace
 
 
 class Broker(abc.ABC):
@@ -401,6 +402,8 @@ class InProcBroker(Broker):
             return {wid: dict(info) for wid, info in self._workers.items()}
 
     def push_request_to(self, worker_id: str, req: GenerateRequest) -> None:
+        trace.ensure_context(req)
+        trace.record(req.id, "enqueue", trace_id=req.trace_id, queue=worker_id)
         with self._route_lock:
             q = self._routed.setdefault(worker_id, queue.Queue())
         q.put(req)
@@ -446,6 +449,9 @@ class InProcBroker(Broker):
             if disp == "expired":
                 with self._lease_lock:
                     self._delivery_counts["deadline_expired"] += 1
+                trace.record(
+                    req.id, "deadline", attempt=req.delivery_attempts,
+                )
                 self.push_response(GenerateResponse(
                     id=req.id, error="deadline exceeded before completion",
                 ))
@@ -453,6 +459,9 @@ class InProcBroker(Broker):
                 with self._lease_lock:
                     self._delivery_counts["dead_lettered"] += 1
                     self._dlq.append(req)
+                trace.record(
+                    req.id, "dead_letter", attempt=req.delivery_attempts,
+                )
                 self.push_response(GenerateResponse(
                     id=req.id,
                     error=(
@@ -465,6 +474,8 @@ class InProcBroker(Broker):
         if out:
             with self._lease_lock:
                 self._delivery_counts["failover_rerouted"] += len(out)
+            for req in out:
+                trace.record(req.id, "failover", worker=worker_id)
         return out
 
     # -- KV handoff channel --------------------------------------------------
@@ -480,10 +491,18 @@ class InProcBroker(Broker):
             self._delivery_counts["handoff_bytes"] += len(record.payload)
 
     def push_handoff(self, record) -> None:
+        trace.record(
+            record.req.id, "handoff_push", trace_id=record.req.trace_id,
+            bytes=len(record.payload), target="shared",
+        )
         self._handoffs.put(record)
         self._handoff_settled(record)
 
     def push_handoff_to(self, worker_id: str, record) -> None:
+        trace.record(
+            record.req.id, "handoff_push", trace_id=record.req.trace_id,
+            bytes=len(record.payload), target=worker_id,
+        )
         with self._route_lock:
             q = self._handoff_routed.setdefault(worker_id, queue.Queue())
         q.put(record)
@@ -511,6 +530,10 @@ class InProcBroker(Broker):
             self._handoff_leases[rec.req.id] = (
                 time.monotonic() + self.lease_s, rec, worker_id,
             )
+        trace.record(
+            rec.req.id, "handoff_lease", trace_id=rec.req.trace_id,
+            worker=worker_id,
+        )
         return rec
 
     def touch_handoffs(self, request_ids) -> None:
@@ -522,6 +545,7 @@ class InProcBroker(Broker):
                     self._handoff_leases[rid] = (
                         now + self.lease_s, held[1], held[2],
                     )
+                    trace.record(rid, "handoff_renew", throttle_s=1.0)
 
     def ack_handoff(self, request_id: str) -> None:
         with self._lease_lock:
@@ -538,6 +562,7 @@ class InProcBroker(Broker):
         if disp == "expired":
             with self._lease_lock:
                 self._delivery_counts["deadline_expired"] += 1
+            trace.record(req.id, "deadline", attempt=req.delivery_attempts)
             self.push_response(GenerateResponse(
                 id=req.id, error="deadline exceeded before completion",
             ))
@@ -545,6 +570,7 @@ class InProcBroker(Broker):
             with self._lease_lock:
                 self._delivery_counts["dead_lettered"] += 1
                 self._dlq.append(req)
+            trace.record(req.id, "dead_letter", attempt=req.delivery_attempts)
             self.push_response(GenerateResponse(
                 id=req.id,
                 error=(
@@ -555,6 +581,13 @@ class InProcBroker(Broker):
         else:
             with self._lease_lock:
                 self._delivery_counts["reprefills"] += 1
+            # Same trace_id, bumped attempt: the re-prefill stays inside
+            # the ORIGINAL request's timeline.
+            req.trace_attempt += 1
+            trace.record(
+                req.id, "reprefill", trace_id=req.trace_id,
+                attempt=req.trace_attempt,
+            )
             self._requests.put(req)
 
     def fail_handoff(self, record, error: str | None = None) -> None:
@@ -608,6 +641,10 @@ class InProcBroker(Broker):
         if out:
             with self._lease_lock:
                 self._delivery_counts["failover_rerouted"] += len(out)
+            for rec in out:
+                trace.record(
+                    rec.req.id, "failover", worker=worker_id, kind="handoff",
+                )
         return out
 
     def push_stream(self, request_id: str, token_ids: list[int]) -> None:
@@ -663,6 +700,8 @@ class InProcBroker(Broker):
         return self._metrics
 
     def push_request(self, req: GenerateRequest) -> None:
+        trace.ensure_context(req)
+        trace.record(req.id, "enqueue", trace_id=req.trace_id, queue="shared")
         self._requests.put(req)
 
     def pop_request(
@@ -693,6 +732,10 @@ class InProcBroker(Broker):
             self._leases[req.id] = (
                 time.monotonic() + self.lease_s, req, worker_id,
             )
+        trace.record(
+            req.id, "lease", trace_id=req.trace_id,
+            worker=worker_id, attempt=req.delivery_attempts,
+        )
         return req
 
     def touch_requests(self, request_ids) -> None:
@@ -702,6 +745,7 @@ class InProcBroker(Broker):
                 held = self._leases.get(rid)
                 if held is not None:
                     self._leases[rid] = (now + self.lease_s, held[1], held[2])
+                    trace.record(rid, "lease_renew", throttle_s=1.0)
 
     def reap_expired(self) -> int:
         now = time.monotonic()
@@ -717,6 +761,9 @@ class InProcBroker(Broker):
             if disp == "expired":
                 with self._lease_lock:
                     self._delivery_counts["deadline_expired"] += 1
+                trace.record(
+                    req.id, "deadline", attempt=req.delivery_attempts,
+                )
                 self.push_response(GenerateResponse(
                     id=req.id, error="deadline exceeded before completion",
                 ))
@@ -724,6 +771,9 @@ class InProcBroker(Broker):
                 with self._lease_lock:
                     self._delivery_counts["dead_lettered"] += 1
                     self._dlq.append(req)
+                trace.record(
+                    req.id, "dead_letter", attempt=req.delivery_attempts,
+                )
                 self.push_response(GenerateResponse(
                     id=req.id,
                     error=(
@@ -734,6 +784,9 @@ class InProcBroker(Broker):
             else:
                 with self._lease_lock:
                     self._delivery_counts["redelivered"] += 1
+                trace.record(
+                    req.id, "redeliver", attempt=req.delivery_attempts,
+                )
                 self._requests.put(req)
         # Expired handoff leases: the decode replica that adopted the
         # blocks is presumed dead — standard handoff disposition
@@ -761,6 +814,7 @@ class InProcBroker(Broker):
                 continue
             req = held[1]
             req.delivery_attempts = max(0, req.delivery_attempts - 1)
+            trace.record(rid, "release")
             self._requests.put(req)
             n += 1
         return n
@@ -801,6 +855,10 @@ class InProcBroker(Broker):
         # Terminal response = ack: the lease is settled, never redelivered.
         # Handoff leases settle here too — the decode worker's answer IS
         # its ack, same contract as the request lease.
+        trace.record(
+            resp.id, "respond", ok=resp.error is None,
+            **({"error": resp.error} if resp.error else {}),
+        )
         with self._lease_lock:
             self._leases.pop(resp.id, None)
             self._handoff_leases.pop(resp.id, None)
@@ -950,6 +1008,8 @@ class RedisBroker(Broker):
         return out
 
     def push_request_to(self, worker_id: str, req: GenerateRequest) -> None:
+        trace.ensure_context(req)
+        trace.record(req.id, "enqueue", trace_id=req.trace_id, queue=worker_id)
         self._r.lpush(self._routed_key(worker_id), req.to_json())
 
     def routed_depths(self) -> dict:
@@ -994,12 +1054,18 @@ class RedisBroker(Broker):
             disp = self._expiry_disposition(req)
             if disp == "expired":
                 self._r.incr(f"{self._stats_prefix}:deadline_expired")
+                trace.record(
+                    req.id, "deadline", attempt=req.delivery_attempts,
+                )
                 self.push_response(GenerateResponse(
                     id=req.id, error="deadline exceeded before completion",
                 ))
             elif disp == "dead-letter":
                 self._r.incr(f"{self._stats_prefix}:dead_lettered")
                 self._r.lpush(self._dlq_key, req.to_json())
+                trace.record(
+                    req.id, "dead_letter", attempt=req.delivery_attempts,
+                )
                 self.push_response(GenerateResponse(
                     id=req.id,
                     error=(
@@ -1009,8 +1075,9 @@ class RedisBroker(Broker):
                 ))
             else:
                 out.append(req)
-        for _ in out:
+        for req in out:
             self._r.incr(f"{self._stats_prefix}:failover_rerouted")
+            trace.record(req.id, "failover", worker=worker_id)
         return out
 
     # -- KV handoff channel --------------------------------------------------
@@ -1031,10 +1098,18 @@ class RedisBroker(Broker):
         )
 
     def push_handoff(self, record) -> None:
+        trace.record(
+            record.req.id, "handoff_push", trace_id=record.req.trace_id,
+            bytes=len(record.payload), target="shared",
+        )
         self._r.lpush(self._handoff_key, record.to_json())
         self._handoff_settled(record)
 
     def push_handoff_to(self, worker_id: str, record) -> None:
+        trace.record(
+            record.req.id, "handoff_push", trace_id=record.req.trace_id,
+            bytes=len(record.payload), target=worker_id,
+        )
         self._r.lpush(self._routed_handoff_key(worker_id), record.to_json())
         self._handoff_settled(record)
 
@@ -1068,6 +1143,10 @@ class RedisBroker(Broker):
             }),
             ex=self._lease_ttl(),
         )
+        trace.record(
+            rec.req.id, "handoff_lease", trace_id=rec.req.trace_id,
+            worker=self._worker_id,
+        )
         return rec
 
     def touch_handoffs(self, request_ids) -> None:
@@ -1081,6 +1160,7 @@ class RedisBroker(Broker):
             entry = json.loads(raw)
             entry["expires_at"] = self._now() + self.lease_s
             self._r.set(key, json.dumps(entry), ex=self._lease_ttl())
+            trace.record(rid, "handoff_renew", throttle_s=1.0)
 
     def ack_handoff(self, request_id: str) -> None:
         self._r.delete(self._hlease_key(request_id))
@@ -1090,12 +1170,14 @@ class RedisBroker(Broker):
         disp = self._expiry_disposition(req)
         if disp == "expired":
             self._r.incr(f"{self._stats_prefix}:deadline_expired")
+            trace.record(req.id, "deadline", attempt=req.delivery_attempts)
             self.push_response(GenerateResponse(
                 id=req.id, error="deadline exceeded before completion",
             ))
         elif disp == "dead-letter":
             self._r.incr(f"{self._stats_prefix}:dead_lettered")
             self._r.lpush(self._dlq_key, req.to_json())
+            trace.record(req.id, "dead_letter", attempt=req.delivery_attempts)
             self.push_response(GenerateResponse(
                 id=req.id,
                 error=(
@@ -1105,8 +1187,14 @@ class RedisBroker(Broker):
             ))
         else:
             # Re-prefill: RPUSH so the (oldest) request heads the service
-            # order, exactly like a redelivery.
+            # order, exactly like a redelivery. Same trace_id, bumped
+            # attempt — the re-prefill stays inside the original timeline.
             self._r.incr(f"{self._stats_prefix}:reprefills")
+            req.trace_attempt += 1
+            trace.record(
+                req.id, "reprefill", trace_id=req.trace_id,
+                attempt=req.trace_attempt,
+            )
             self._r.rpush(self._rq, req.to_json())
 
     def fail_handoff(self, record, error: str | None = None) -> None:
@@ -1159,8 +1247,11 @@ class RedisBroker(Broker):
                 continue  # a reaper claimed it concurrently
             rec = HandoffRecord.from_json(json.loads(raw)["rec"])
             self._dispose_handoff(rec)
-        for _ in out:
+        for rec in out:
             self._r.incr(f"{self._stats_prefix}:failover_rerouted")
+            trace.record(
+                rec.req.id, "failover", worker=worker_id, kind="handoff",
+            )
         return out
 
     # -- lease plumbing -----------------------------------------------------
@@ -1212,6 +1303,7 @@ class RedisBroker(Broker):
             entry = json.loads(raw)
             entry["expires_at"] = self._now() + self.lease_s
             self._r.set(key, json.dumps(entry), ex=self._lease_ttl())
+            trace.record(rid, "lease_renew", throttle_s=1.0)
 
     def reap_expired(self) -> int:
         import json
@@ -1231,12 +1323,18 @@ class RedisBroker(Broker):
             disp = self._expiry_disposition(req)
             if disp == "expired":
                 self._r.incr(f"{self._stats_prefix}:deadline_expired")
+                trace.record(
+                    req.id, "deadline", attempt=req.delivery_attempts,
+                )
                 self.push_response(GenerateResponse(
                     id=req.id, error="deadline exceeded before completion",
                 ))
             elif disp == "dead-letter":
                 self._r.incr(f"{self._stats_prefix}:dead_lettered")
                 self._r.lpush(self._dlq_key, req.to_json())
+                trace.record(
+                    req.id, "dead_letter", attempt=req.delivery_attempts,
+                )
                 self.push_response(GenerateResponse(
                     id=req.id,
                     error=(
@@ -1246,6 +1344,9 @@ class RedisBroker(Broker):
                 ))
             else:
                 self._r.incr(f"{self._stats_prefix}:redelivered")
+                trace.record(
+                    req.id, "redeliver", attempt=req.delivery_attempts,
+                )
                 # RPUSH: the pop side RPOPs, so a redelivered (oldest)
                 # request goes to the head of the service order.
                 self._r.rpush(self._rq, req.to_json())
@@ -1280,6 +1381,7 @@ class RedisBroker(Broker):
                 continue  # a reaper claimed it concurrently — it requeues
             req = GenerateRequest.from_json(json.loads(raw)["req"])
             req.delivery_attempts = max(0, req.delivery_attempts - 1)
+            trace.record(rid, "release")
             # RPUSH like the reaper: released (oldest) work goes back to
             # the head of the service order.
             self._r.rpush(self._rq, req.to_json())
@@ -1364,6 +1466,8 @@ class RedisBroker(Broker):
         return {r for r, v in zip(ids, vals) if v is not None}
 
     def push_request(self, req: GenerateRequest) -> None:
+        trace.ensure_context(req)
+        trace.record(req.id, "enqueue", trace_id=req.trace_id, queue="shared")
         self._r.lpush(self._rq, req.to_json())
 
     def pop_request(
@@ -1393,12 +1497,20 @@ class RedisBroker(Broker):
         req = GenerateRequest.from_json(payload)
         req.delivery_attempts += 1
         self._write_lease(req)
+        trace.record(
+            req.id, "lease", trace_id=req.trace_id,
+            worker=self._worker_id, attempt=req.delivery_attempts,
+        )
         return req
 
     def push_response(self, resp: GenerateResponse) -> None:
         # Terminal response == ack: release the lease so the reaper never
         # redelivers completed work. Handoff leases settle here too — the
         # decode worker's answer IS its ack.
+        trace.record(
+            resp.id, "respond", ok=resp.error is None,
+            **({"error": resp.error} if resp.error else {}),
+        )
         self._r.delete(self._lease_key(resp.id))
         self._r.delete(self._hlease_key(resp.id))
         key = f"{self._prefix}:{resp.id}"

@@ -36,6 +36,7 @@ from llmss_tpu.serve.protocol import (
     GenerateResponse,
     prefix_hash,
 )
+from llmss_tpu.utils import trace
 
 logger = logging.getLogger("llmss_tpu.serve")
 
@@ -155,6 +156,12 @@ class Worker:
             # `time.time() - heartbeat_ts` in another process, and
             # monotonic epochs don't line up across processes.
             "heartbeat_ts": _time.time(),  # lint: ignore[wall-clock-timer]
+            # Flight-recorder snapshot: rides the registry heartbeat so
+            # the producer can stitch fleet-wide timelines (GET /trace).
+            **(
+                {"trace": trace.recorder().export(max_events=256)}
+                if trace.enabled() else {}
+            ),
         }
 
     def _publish_load(self) -> None:
@@ -303,6 +310,7 @@ class Worker:
 
         poisoned_rows: set[int] = set()
         self._inflight_rows = n_live
+        t_batch = time.monotonic()
         try:
             outs = self.engine.generate(
                 prompts, gens, cancel_poll=cancel_poll,
@@ -324,6 +332,14 @@ class Worker:
         finally:
             self._inflight_rows = 0
 
+        # One batch generate == one decode phase for every live row; the
+        # per-request event shares the batch duration (rows run in parallel).
+        dur_batch = time.monotonic() - t_batch
+        for req in ok:
+            trace.record(
+                req.id, "decode", trace_id=req.trace_id, dur_s=dur_batch,
+                worker=self.worker_id, batch=n_live,
+            )
         for row, (req, toks) in enumerate(zip(ok, outs)):
             if row in poisoned_rows:
                 # Per-row poison containment: this row's logits went
@@ -474,6 +490,11 @@ class ContinuousWorker:
             "heartbeat_s": self.snapshot_interval_s,
             # Cross-process staleness stamp (see Worker.load_snapshot).
             "heartbeat_ts": _time.time(),  # lint: ignore[wall-clock-timer]
+            # Flight-recorder snapshot (see Worker.load_snapshot).
+            **(
+                {"trace": trace.recorder().export(max_events=256)}
+                if trace.enabled() else {}
+            ),
         })
         return snap
 
@@ -618,10 +639,15 @@ class ContinuousWorker:
                 GenerateResponse(id=rid, error="exported request lost")
             )
             return
-        payload = encode_blocks(
-            blocks, req_id=rid, n_tokens=n_tokens,
-            block_size=self.engine.block_size,
-        )
+        with trace.span(
+            rid, "kv_export", trace_id=req.trace_id,
+            worker=self.worker_id, n_tokens=n_tokens,
+        ):
+            payload = encode_blocks(
+                blocks, req_id=rid, n_tokens=n_tokens,
+                block_size=self.engine.block_size,
+                trace_id=req.trace_id,
+            )
         rec = HandoffRecord(
             req=req, first_token=first, n_tokens=n_tokens, payload=payload,
         )
@@ -650,8 +676,12 @@ class ContinuousWorker:
             return True
         try:
             gen = gen_params_from(self.tokenizer, req)
-            d = decode_blocks(rec.payload)
-            blocks = {k: d[k] for k in ("k", "v", "k_scale", "v_scale")}
+            with trace.span(
+                req.id, "kv_adopt", trace_id=req.trace_id,
+                worker=self.worker_id, bytes=len(rec.payload),
+            ):
+                d = decode_blocks(rec.payload)
+                blocks = {k: d[k] for k in ("k", "v", "k_scale", "v_scale")}
         except Exception as e:  # noqa: BLE001 — corrupt payload quarantine
             # fail_handoff re-queues the REQUEST (re-prefill makes a fresh
             # payload); repeat offenders hit the delivery-attempt cap and

@@ -55,6 +55,7 @@ from llmss_tpu.serve.protocol import (
     GenerateRequest,
     prefix_hash,
 )
+from llmss_tpu.utils import trace
 
 
 def _worker_health(info: dict, stale_factor: float = 3.0) -> tuple[int, dict]:
@@ -97,7 +98,10 @@ def fleet_status(
         routable = code == 200 and info.get("state", STATE_READY) == STATE_READY
         ready += int(routable)
         workers[wid] = {
-            **info,
+            # The flight-recorder snapshot rides the heartbeat for
+            # GET /trace stitching — hundreds of events would drown the
+            # operator-facing fleet view, so it stays off /fleet.
+            **{k: v for k, v in info.items() if k != "trace"},
             "role": info.get("role", "unified"),
             "health": body.get("status"),
             "routable": routable,
@@ -243,13 +247,22 @@ class Router:
         when no replica is routable (shared-queue fallback — any worker
         that appears later serves it)."""
         self.check_failover()
+        trace.ensure_context(req)
         infos = self._request_targets()
         if not infos:
             with self._lock:
                 self._counts["shared_fallback"] += 1
+            trace.record(
+                req.id, "route", trace_id=req.trace_id,
+                policy=self.policy, worker="shared",
+            )
             self.broker.push_request(req)
             return None
         wid = self._pick(req, infos)
+        trace.record(
+            req.id, "route", trace_id=req.trace_id,
+            policy=self.policy, worker=wid,
+        )
         self.broker.push_request_to(wid, req)
         with self._lock:
             self._counts["routed_total"] += 1

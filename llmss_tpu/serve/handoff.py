@@ -62,6 +62,7 @@ import numpy as np
 from llmss_tpu.serve.protocol import (
     STATE_READY, GenerateRequest, GenerateResponse,
 )
+from llmss_tpu.utils import trace
 
 #: Wire-format magic + version. Bump on any layout change — decoders
 #: refuse unknown versions instead of guessing.
@@ -87,6 +88,7 @@ def _dtype_of(name: str):
 
 def encode_blocks(
     blocks: dict, *, req_id: str, n_tokens: int, block_size: int,
+    trace_id: str | None = None,
 ) -> bytes:
     """Serialize an ``export_blocks`` dict into the handoff wire format."""
     bufs: list[bytes] = []
@@ -106,6 +108,7 @@ def encode_blocks(
     header = json.dumps({
         "version": _VERSION,
         "req_id": req_id,
+        "trace_id": trace_id,
         "n_tokens": int(n_tokens),
         "block_size": int(block_size),
         "quantized": blocks.get("k_scale") is not None,
@@ -145,6 +148,7 @@ def decode_blocks(data: bytes) -> dict:
         raise ValueError("bad handoff payload: CRC mismatch")
     out = {
         "req_id": header["req_id"],
+        "trace_id": header.get("trace_id"),
         "n_tokens": header["n_tokens"],
         "block_size": header["block_size"],
         "quantized": header["quantized"],
@@ -241,6 +245,8 @@ class _RoleWorkerBase:
         self.poll_timeout_s = poll_timeout_s
         self.snapshot_interval_s = snapshot_interval_s
         self._last_snapshot = 0.0  # monotonic
+        self._trace_blob: dict | None = None
+        self._last_trace_pub = 0.0  # monotonic
         self._inflight = 0
         broker.register_worker({
             "worker_id": self.worker_id,
@@ -266,7 +272,22 @@ class _RoleWorkerBase:
             # against their own time.time() across processes.
             "heartbeat_ts": time.time(),  # lint: ignore[wall-clock-timer]
             "heartbeat_interval_s": self.snapshot_interval_s,
+            # Flight-recorder snapshot: rides the registry heartbeat so the
+            # producer can stitch fleet-wide timelines (GET /trace/{id}).
+            # Exported at heartbeat cadence, not per publish — forced
+            # per-request publishes re-attach the cached blob so the
+            # request hot path never pays the O(events) export.
+            **({"trace": self._trace_export(now)} if trace.enabled() else {}),
         })
+
+    def _trace_export(self, now: float) -> dict:
+        if (
+            self._trace_blob is None
+            or now - self._last_trace_pub >= self.snapshot_interval_s
+        ):
+            self._last_trace_pub = now
+            self._trace_blob = trace.recorder().export(max_events=256)
+        return self._trace_blob
 
 
 class PrefillWorker(_RoleWorkerBase):
@@ -302,9 +323,13 @@ class PrefillWorker(_RoleWorkerBase):
         self._publish(force=True)
         try:
             try:
-                first, payload = self.engine.prefill_export(
-                    list(req.token_ids or []), req.max_new_tokens,
-                )
+                with trace.span(
+                    req.id, "prefill", trace_id=req.trace_id,
+                    worker=self.worker_id, n_tokens=len(req.token_ids or []),
+                ):
+                    first, payload = self.engine.prefill_export(
+                        list(req.token_ids or []), req.max_new_tokens,
+                    )
             except Exception as e:  # noqa: BLE001 — worker must answer
                 self.broker.push_response(GenerateResponse(
                     id=req.id, error=f"prefill failed: {e}",
@@ -360,11 +385,18 @@ class DecodeWorker(_RoleWorkerBase):
         rid = rec.req.id
         try:
             try:
-                toks = self.engine.adopt_generate(
-                    rec.payload, rec.req.max_new_tokens, rec.first_token,
-                    rec.n_tokens,
-                    on_increment=lambda: self.broker.touch_handoffs([rid]),
-                )
+                with trace.span(
+                    rid, "decode", trace_id=rec.req.trace_id,
+                    worker=self.worker_id,
+                    max_new_tokens=rec.req.max_new_tokens,
+                ):
+                    toks = self.engine.adopt_generate(
+                        rec.payload, rec.req.max_new_tokens, rec.first_token,
+                        rec.n_tokens,
+                        on_increment=lambda: self.broker.touch_handoffs(
+                            [rid],
+                        ),
+                    )
             except Exception as e:  # noqa: BLE001 — disposition, don't die
                 self.broker.fail_handoff(rec, error=str(e))
                 return 1

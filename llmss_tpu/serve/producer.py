@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 
 from llmss_tpu.serve.broker import Broker
 from llmss_tpu.serve.protocol import (
@@ -22,6 +23,85 @@ from llmss_tpu.serve.protocol import (
     STATE_READY,
     GenerateRequest,
 )
+from llmss_tpu.utils import trace
+from llmss_tpu.utils.metrics import profile_trace, render_prometheus
+
+# Prometheus text exposition version served for /metrics?format=prometheus.
+_PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# jax.profiler keeps one global trace per process, so one in-flight
+# POST /profile per process is the correct serialization unit.
+_PROFILE_LOCK = threading.Lock()
+
+
+def collect_trace_exports(broker: Broker) -> list[dict]:
+    """Every flight-recorder export visible from this producer: the local
+    process recorder plus the per-worker snapshots riding the registry
+    heartbeats (``load_snapshot`` embeds ``trace``). ``trace.stitch``
+    dedups events that arrive through both paths."""
+    exports: list[dict] = []
+    if trace.enabled():
+        exports.append(trace.recorder().export())
+    for _wid, info in sorted(broker.read_workers().items()):
+        blob = info.get("trace")
+        if isinstance(blob, dict):
+            exports.append(blob)
+    return exports
+
+
+def trace_timeline_response(
+    broker: Broker, req_id: str, fmt: str = "",
+) -> tuple[int, dict]:
+    """GET /trace/{req_id}: the stitched fleet-wide timeline (404 when no
+    process recorded the id). ``fmt == "chrome"`` returns Chrome
+    trace-event JSON loadable in Perfetto instead."""
+    exports = collect_trace_exports(broker)
+    if fmt == "chrome":
+        if not trace.stitch(exports, req_id=req_id):
+            return 404, {"error": f"no trace for {req_id}"}
+        return 200, trace.to_chrome_trace(exports, req_id=req_id)
+    tl = trace.timeline(exports, req_id)
+    if tl is None:
+        return 404, {"error": f"no trace for {req_id}"}
+    return 200, tl
+
+
+def start_profile(
+    log_dir: str | None = None, duration_s: float = 3.0,
+) -> tuple[int, dict]:
+    """POST /profile: capture an on-demand ``jax.profiler`` trace for
+    ``duration_s`` seconds in a background thread (the serving loop keeps
+    running — the profiler observes it). 409 while one is in flight; 501
+    when jax is not importable (the producer itself never needs it)."""
+    import tempfile
+    import time as _time
+
+    try:
+        duration_s = min(max(float(duration_s), 0.1), 60.0)
+    except (TypeError, ValueError):
+        return 400, {"error": "duration_s must be a number"}
+    try:
+        import jax  # noqa: F401 — availability gate only
+    except Exception as e:  # noqa: BLE001 — report, don't crash the route
+        return 501, {"error": f"jax unavailable: {e}"}
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        return 409, {"error": "profile already in progress"}
+    if log_dir is None:
+        log_dir = tempfile.mkdtemp(prefix="llmss-profile-")
+
+    def run():
+        try:
+            with profile_trace(log_dir):
+                _time.sleep(duration_s)
+        except Exception:  # noqa: BLE001 — background capture best-effort
+            pass
+        finally:
+            _PROFILE_LOCK.release()
+
+    threading.Thread(target=run, daemon=True).start()
+    return 202, {
+        "profiling": True, "log_dir": log_dir, "duration_s": duration_s,
+    }
 
 
 def evaluate_worker_health(
@@ -142,28 +222,53 @@ class ProducerServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _reply_text(self, code: int, text: str, ctype: str):
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
-                if self.path == "/health":
+                parts = urlsplit(self.path)
+                path, q = parts.path, parse_qs(parts.query)
+                if path == "/health":
                     code, body = outer.health()
                     self._reply(code, body)
-                elif self.path == "/fleet":
+                elif path == "/fleet":
                     self._reply(200, outer.fleet())
-                elif self.path == "/metrics":
-                    payload = {
-                        **outer.broker.read_metrics(),
-                        "delivery": outer.broker.delivery_stats(),
-                    }
-                    fleet = outer.fleet_metrics()
-                    if fleet is not None:
-                        payload["fleet"] = fleet
-                    self._reply(200, payload)
-                elif self.path == "/dlq":
+                elif path == "/metrics":
+                    payload = outer.metrics_payload()
+                    if q.get("format", [""])[0] == "prometheus":
+                        self._reply_text(
+                            200, render_prometheus(payload),
+                            _PROM_CONTENT_TYPE,
+                        )
+                    else:
+                        # JSON stays the default and byte-identical to the
+                        # pre-Prometheus payload.
+                        self._reply(200, payload)
+                elif path == "/dlq":
                     # Admin surface for quarantined poison requests: depth
                     # plus the most recent dead-lettered payloads.
                     self._reply(200, {
                         "depth": outer.broker.dlq_depth(),
                         "requests": outer.broker.read_dlq(),
                     })
+                elif path == "/trace/slowest":
+                    try:
+                        n = int(q.get("n", ["10"])[0])
+                    except ValueError:
+                        self._reply(400, {"error": "n must be an integer"})
+                        return
+                    self._reply(200, {"slowest": outer.trace_slowest(n)})
+                elif path.startswith("/trace/"):
+                    rid = path[len("/trace/"):]
+                    code, body = trace_timeline_response(
+                        outer.broker, rid, q.get("format", [""])[0],
+                    )
+                    self._reply(code, body)
                 else:
                     self._reply(404, {"error": "not found"})
 
@@ -171,11 +276,16 @@ class ProducerServer:
                 """Admission control + deadline stamping. Returns False
                 (with the 429/503 already sent) when the backlog is full
                 or the worker lifecycle says stop sending traffic."""
+                trace.ensure_context(req)
                 state = outer.worker_unavailable()
                 if state is not None:
                     # Draining/dead worker: queueing would only strand the
                     # request past its deadline (draining workers lease
                     # nothing new). Shed like a load balancer would.
+                    trace.record(
+                        req.id, "reject", trace_id=req.trace_id,
+                        reason=f"worker {state}",
+                    )
                     body = json.dumps({
                         "error": f"worker {state}", "id": req.id,
                     }).encode()
@@ -190,6 +300,10 @@ class ProducerServer:
                     outer.max_queue_depth
                     and outer.broker.queue_depth() >= outer.max_queue_depth
                 ):
+                    trace.record(
+                        req.id, "reject", trace_id=req.trace_id,
+                        reason="queue full",
+                    )
                     body = json.dumps({
                         "error": "queue full", "id": req.id,
                         "queue_depth": outer.broker.queue_depth(),
@@ -208,6 +322,10 @@ class ProducerServer:
                     import time as _time
 
                     req.deadline_ts = _time.time() + outer.timeout_s
+                trace.record(
+                    req.id, "accept", trace_id=req.trace_id,
+                    timeout_s=outer.timeout_s, stream=req.stream,
+                )
                 return True
 
             def _stream_response(self, req):
@@ -278,6 +396,19 @@ class ProducerServer:
                     outer.broker.drop_stream(req.id)
 
             def do_POST(self):
+                if self.path == "/profile":
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        body = json.loads(self.rfile.read(n)) if n else {}
+                    except Exception as e:  # noqa: BLE001 — client error
+                        self._reply(400, {"error": str(e)})
+                        return
+                    code, out = start_profile(
+                        body.get("log_dir"),
+                        body.get("duration_s", 3.0),
+                    )
+                    self._reply(code, out)
+                    return
                 if self.path == "/cancel":
                     try:
                         n = int(self.headers.get("Content-Length", 0))
@@ -356,6 +487,23 @@ class ProducerServer:
         return fleet_status(
             self.broker, self.router, self.HEARTBEAT_STALE_FACTOR,
         )
+
+    def metrics_payload(self) -> dict:
+        """The GET /metrics JSON payload (also the input to the
+        Prometheus rendering — one payload, two encodings)."""
+        payload = {
+            **self.broker.read_metrics(),
+            "delivery": self.broker.delivery_stats(),
+        }
+        fleet = self.fleet_metrics()
+        if fleet is not None:
+            payload["fleet"] = fleet
+        return payload
+
+    def trace_slowest(self, n: int = 10) -> list[dict]:
+        """GET /trace/slowest: the n slowest requests visible fleet-wide,
+        each with its dominant phase (where the time actually went)."""
+        return trace.slowest(collect_trace_exports(self.broker), n=n)
 
     def fleet_metrics(self) -> dict | None:
         """Fleet block for GET /metrics: per-worker load/queue-depth
@@ -440,12 +588,17 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
     streaming via ``stream: true``, same event format, 429 + Retry-After
     admission control, lifecycle-aware 503 shedding, deadline stamping,
     policy routing when a ``router`` is given), POST /cancel,
-    GET /metrics, GET /health (fleet-aggregate when a worker registry is
-    populated), GET /fleet, GET /dlq."""
+    POST /profile, GET /metrics (?format=prometheus), GET /health
+    (fleet-aggregate when a worker registry is populated), GET /fleet,
+    GET /dlq, GET /trace/{req_id} (?format=chrome), GET /trace/slowest."""
     import time as _time
 
     from fastapi import FastAPI, HTTPException
-    from fastapi.responses import JSONResponse, StreamingResponse
+    from fastapi.responses import (
+        JSONResponse,
+        PlainTextResponse,
+        StreamingResponse,
+    )
 
     app = FastAPI()
     hstate = {"saw_supervisor": False, "memo": None, "memo_until": 0.0}
@@ -518,14 +671,23 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
             req.validate()
         except ValueError as e:
             raise HTTPException(400, str(e)) from e
+        trace.ensure_context(req)
         state = _worker_unavailable()
         if state is not None:
+            trace.record(
+                req.id, "reject", trace_id=req.trace_id,
+                reason=f"worker {state}",
+            )
             return JSONResponse(
                 status_code=503,
                 content={"error": f"worker {state}", "id": req.id},
                 headers={"Retry-After": "1"},
             )
         if max_queue_depth and broker.queue_depth() >= max_queue_depth:
+            trace.record(
+                req.id, "reject", trace_id=req.trace_id,
+                reason="queue full",
+            )
             return JSONResponse(
                 status_code=429,
                 content={"error": "queue full", "id": req.id,
@@ -534,6 +696,10 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
             )
         if req.deadline_ts is None:
             req.deadline_ts = _time.time() + timeout_s
+        trace.record(
+            req.id, "accept", trace_id=req.trace_id,
+            timeout_s=timeout_s, stream=req.stream,
+        )
         _submit(req)
         if req.stream:
             return StreamingResponse(
@@ -557,7 +723,7 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
         return {"cancelled": rid}
 
     @app.get("/metrics")
-    def metrics():
+    def metrics(format: str | None = None):
         payload = {
             **broker.read_metrics(),
             "delivery": broker.delivery_stats(),
@@ -580,7 +746,28 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
             if router is not None:
                 fleet["router"] = router.stats()
             payload["fleet"] = fleet
+        if format == "prometheus":
+            return PlainTextResponse(
+                render_prometheus(payload), media_type=_PROM_CONTENT_TYPE,
+            )
         return payload
+
+    @app.get("/trace/slowest")
+    def trace_slowest(n: int = 10):
+        return {"slowest": trace.slowest(collect_trace_exports(broker), n=n)}
+
+    @app.get("/trace/{req_id}")
+    def trace_req(req_id: str, format: str | None = None):
+        code, body = trace_timeline_response(broker, req_id, format or "")
+        return JSONResponse(status_code=code, content=body)
+
+    @app.post("/profile")
+    def profile(payload: dict | None = None):
+        payload = payload or {}
+        code, body = start_profile(
+            payload.get("log_dir"), payload.get("duration_s", 3.0),
+        )
+        return JSONResponse(status_code=code, content=body)
 
     @app.get("/fleet")
     def fleet():

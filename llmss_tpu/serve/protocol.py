@@ -79,6 +79,15 @@ class GenerateRequest:
     # before prefill, and the broker's lease reaper sheds them at
     # redelivery time instead of requeueing work nobody is waiting for.
     deadline_ts: float | None = None
+    # Distributed-trace context (utils/trace.py): ``trace_id`` is stamped
+    # at first admission (defaults to the request id) and carried through
+    # both brokers and the LKVH handoff header so every hop lands in one
+    # timeline; ``trace_attempt`` bumps when a handoff-lease expiry
+    # re-prefills the request, distinguishing attempts inside the SAME
+    # trace (unlike ``delivery_attempts``, which also counts redeliveries
+    # of the original queue lease).
+    trace_id: str | None = None
+    trace_attempt: int = 0
     id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
 
     def to_json(self) -> str:

@@ -1,5 +1,15 @@
 """Cross-cutting utilities: metrics, structured logging, profiling."""
 
-from llmss_tpu.utils.metrics import EngineMetrics, LatencyStat, profile_trace
+from llmss_tpu.utils.metrics import (
+    EngineMetrics,
+    LatencyStat,
+    profile_trace,
+    render_prometheus,
+)
 
-__all__ = ["EngineMetrics", "LatencyStat", "profile_trace"]
+__all__ = [
+    "EngineMetrics",
+    "LatencyStat",
+    "profile_trace",
+    "render_prometheus",
+]

@@ -12,6 +12,7 @@ tokens/sec/chip and p50 TTFT).
 from __future__ import annotations
 
 import contextlib
+import random
 import threading
 import time
 
@@ -27,6 +28,8 @@ class LatencyStat:
         self._total = 0.0  # guarded_by: self._lock
         # most recent sample (seconds)
         self.last_s: float | None = None  # guarded_by: self._lock
+        # Seeded per-stat so reservoir contents are reproducible in tests.
+        self._rng = random.Random(name)  # guarded_by: self._lock
         self._lock = threading.Lock()
 
     def record(self, seconds: float) -> None:
@@ -35,8 +38,15 @@ class LatencyStat:
             self._total += seconds
             self.last_s = seconds
             if len(self._samples) >= self.max_samples:
-                # overwrite pseudo-randomly to keep a sliding reservoir
-                self._samples[self._count % self.max_samples] = seconds
+                # Algorithm-R reservoir sampling: item i replaces a random
+                # slot with probability k/i, leaving every sample seen so
+                # far equally likely to be retained. (The previous
+                # ``_count % max_samples`` overwrite was a deterministic
+                # stride that evicted whole time-slices under steady
+                # arrival, skewing p95/p99.)
+                j = self._rng.randrange(self._count)
+                if j < self.max_samples:
+                    self._samples[j] = seconds
             else:
                 self._samples.append(seconds)
 
@@ -205,6 +215,87 @@ class EngineMetrics:
                 if self.spec_stats is not None else {}
             ),
         }
+
+
+# Shape signature of LatencyStat.to_dict — rendered as a quantile family
+# instead of five flat gauges.
+_LATENCY_KEYS = frozenset({"count", "mean_ms", "p50_ms", "p95_ms", "p99_ms"})
+
+
+def _prom_name(parts) -> str:
+    raw = "_".join(str(p) for p in parts if p != "")
+    return "".join(c if (c.isalnum() or c == "_") else "_" for c in raw)
+
+
+def render_prometheus(payload: dict, prefix: str = "llmss") -> str:
+    """Render the ``GET /metrics`` JSON payload in Prometheus text
+    exposition format (``?format=prometheus``).
+
+    Pure function of the JSON shape: numeric scalars become gauges named by
+    their key path, ``LatencyStat.to_dict`` blocks become a ``_ms`` family
+    labelled by quantile plus ``_count``/``_mean_ms``, and the fleet block's
+    per-worker snapshots get a ``worker`` label. Non-numeric leaves are
+    skipped. The JSON endpoint remains the default and is untouched.
+    """
+    samples: dict[str, list[tuple[dict | None, object]]] = {}
+
+    def emit(name: str, value, labels: dict | None) -> None:
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            return
+        samples.setdefault(name, []).append((labels, value))
+
+    def walk(obj, parts, labels) -> None:
+        if isinstance(obj, dict):
+            if _LATENCY_KEYS.issuperset(obj) and "count" in obj:
+                base = _prom_name([prefix, *parts])
+                emit(base + "_count", obj.get("count"), labels)
+                emit(base + "_mean_ms", obj.get("mean_ms"), labels)
+                for q in ("p50", "p95", "p99"):
+                    emit(
+                        base + "_ms", obj.get(f"{q}_ms"),
+                        {**(labels or {}), "quantile": q},
+                    )
+                return
+            for k, v in obj.items():
+                walk(v, [*parts, k], labels)
+        elif isinstance(obj, list):
+            for item in obj:
+                if isinstance(item, dict) and "worker_id" in item:
+                    wid = item["worker_id"]
+                    rest = {
+                        k: v for k, v in item.items() if k != "worker_id"
+                    }
+                    walk(rest, parts, {**(labels or {}), "worker": wid})
+        else:
+            emit(_prom_name([prefix, *parts]), obj, labels)
+
+    top = {k: v for k, v in payload.items() if k != "fleet"}
+    walk(top, [], None)
+    fleet = payload.get("fleet")
+    if isinstance(fleet, dict):
+        workers = fleet.get("workers")
+        walk(
+            {k: v for k, v in fleet.items() if k != "workers"},
+            ["fleet"], None,
+        )
+        if isinstance(workers, dict):
+            for wid, snap in workers.items():
+                if isinstance(snap, dict):
+                    walk(snap, ["fleet", "worker"], {"worker": wid})
+
+    lines: list[str] = []
+    for name in samples:
+        lines.append(f"# TYPE {name} gauge")
+        for labels, value in samples[name]:
+            lab = ""
+            if labels:
+                body = ",".join(
+                    f'{k}="{v}"' for k, v in sorted(labels.items())
+                )
+                lab = "{" + body + "}"
+            lines.append(f"{name}{lab} {value}")
+    lines.append("")
+    return "\n".join(lines)
 
 
 @contextlib.contextmanager

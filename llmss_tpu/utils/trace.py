@@ -1,0 +1,405 @@
+"""End-to-end request tracing: spans, a per-process flight recorder, and
+Perfetto-loadable export.
+
+One request now crosses a router, a broker lease, a prefill replica, a KV
+handoff, and a decode replica; aggregate reservoirs (``utils/metrics.py``)
+cannot answer "where did request X's p95 go". Every hop records events into
+a bounded per-process :class:`FlightRecorder`; ``GET /trace/{req_id}`` on
+the producer stitches the fleet-wide timeline back together.
+
+Clock discipline (enforced by graftlint's ``wall-clock-timer`` rule): every
+event timestamp and span duration is ``time.monotonic()``. Exactly ONE
+wall-clock read happens per process — the ``wall_anchor`` captured at
+:meth:`FlightRecorder.export` — so cross-process stitching survives clock
+skew: within a process ordering is monotonic-exact, across processes events
+are aligned by ``wall_anchor + (t_mono - mono_anchor)``.
+
+Tracing is ON by default at event granularity. Disable with
+``LLMSS_TRACE=0`` in the environment or :func:`set_enabled` at runtime;
+the disabled fast path is a single attribute check per call site.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+
+# Event names a stitched timeline must end with exactly once: the broker's
+# response channel is the delivery contract's terminal ack.
+TERMINAL_EVENTS = frozenset({"respond"})
+
+# High-frequency per-group / per-renewal events the recorder may shed when a
+# request's ring fills; lifecycle events (enqueue/lease/respond/...) are
+# never shed in their favor.
+_SHEDDABLE_PREFIXES = ("group_",)
+_SHEDDABLE_NAMES = frozenset({"lease_renew", "handoff_renew"})
+
+
+def _sheddable(name: str) -> bool:
+    return name in _SHEDDABLE_NAMES or name.startswith(_SHEDDABLE_PREFIXES)
+
+
+class Span:
+    """A monotonic-duration span over one phase of one request.
+
+    ``end()`` is idempotent and safe on the disabled path (``rec=None``).
+    Usable as a context manager; an exception inside the block is recorded
+    as an ``error`` attribute before the span closes.
+    """
+
+    __slots__ = ("_rec", "req_id", "name", "_t0", "_attrs", "_ended")
+
+    def __init__(self, rec, req_id, name, attrs):
+        self._rec = rec
+        self.req_id = req_id
+        self.name = name
+        self._attrs = attrs
+        self._t0 = time.monotonic()
+        self._ended = False
+
+    def end(self, **attrs) -> None:
+        if self._ended:
+            return
+        self._ended = True
+        if self._rec is None:
+            return
+        if attrs:
+            self._attrs.update(attrs)
+        self._rec.record(
+            self.req_id, self.name,
+            dur_s=time.monotonic() - self._t0, **self._attrs,
+        )
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self._attrs.setdefault("error", exc_type.__name__)
+        self.end()
+        return False
+
+
+class FlightRecorder:
+    """Bounded ring of per-request event histories for one process.
+
+    Retains the ``max_requests`` most recently active requests; each keeps
+    up to ``max_events`` events (overflow sheds group/renewal spam first and
+    counts what it dropped, so a postmortem can see the ring was lossy).
+    """
+
+    def __init__(
+        self,
+        max_requests: int = 256,
+        max_events: int = 512,
+        proc: str | None = None,
+    ):
+        self.max_requests = max_requests
+        self.max_events = max_events
+        self.proc = proc or f"proc-{os.getpid()}"
+        self._lock = threading.Lock()
+        # req_id -> {"trace_id", "events": [dict], "dropped", "last": {name: t}}
+        self._reqs: OrderedDict[str, dict] = OrderedDict()  # guarded_by: self._lock
+
+    # -- recording ----------------------------------------------------------
+
+    def record(
+        self,
+        req_id: str,
+        name: str,
+        *,
+        trace_id: str | None = None,
+        dur_s: float | None = None,
+        proc: str | None = None,
+        throttle_s: float | None = None,
+        **attrs,
+    ) -> None:
+        t = time.monotonic()
+        with self._lock:
+            e = self._reqs.get(req_id)
+            if e is None:
+                while len(self._reqs) >= self.max_requests:
+                    self._reqs.popitem(last=False)
+                e = {"trace_id": None, "events": [], "dropped": 0, "last": {}}
+                self._reqs[req_id] = e
+            else:
+                self._reqs.move_to_end(req_id)
+            if trace_id is not None:
+                e["trace_id"] = trace_id
+            if throttle_s is not None:
+                prev = e["last"].get(name)
+                if prev is not None and t - prev < throttle_s:
+                    return
+            e["last"][name] = t
+            ev = {"req_id": req_id, "name": name, "t": t}
+            if dur_s is not None:
+                ev["dur"] = dur_s
+            if proc is not None:
+                ev["proc"] = proc
+            if attrs:
+                ev["attrs"] = attrs
+            events = e["events"]
+            if len(events) >= self.max_events:
+                if _sheddable(name):
+                    e["dropped"] += 1
+                    return
+                for i, old in enumerate(events):
+                    if _sheddable(old["name"]):
+                        del events[i]
+                        e["dropped"] += 1
+                        break
+                else:
+                    e["dropped"] += 1
+                    return
+            events.append(ev)
+
+    def start_span(self, req_id: str, name: str, **attrs) -> Span:
+        return Span(self, req_id, name, attrs)
+
+    # -- readout ------------------------------------------------------------
+
+    def events_for(self, req_id: str) -> list[dict]:
+        with self._lock:
+            e = self._reqs.get(req_id)
+            return [dict(ev) for ev in e["events"]] if e else []
+
+    def req_ids(self) -> list[str]:
+        with self._lock:
+            return list(self._reqs)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._reqs.clear()
+
+    def export(
+        self,
+        req_ids=None,
+        max_events: int | None = None,
+    ) -> dict:
+        """Snapshot this process's retained timelines for stitching.
+
+        ``max_events`` bounds the total event count (most recent kept) so
+        registry heartbeats stay small. The returned blob is JSON-safe.
+        """
+        with self._lock:
+            reqs = {}
+            budget = max_events if max_events is not None else None
+            for rid in reversed(self._reqs):
+                if req_ids is not None and rid not in req_ids:
+                    continue
+                e = self._reqs[rid]
+                evs = [dict(ev) for ev in e["events"]]
+                if budget is not None:
+                    if budget <= 0:
+                        break
+                    evs = evs[-budget:]
+                    budget -= len(evs)
+                reqs[rid] = {
+                    "trace_id": e["trace_id"],
+                    "dropped": e["dropped"],
+                    "events": evs,
+                }
+        return {
+            "proc": self.proc,
+            "mono_anchor": time.monotonic(),
+            # The ONE wall-clock read per process, taken only at export so
+            # recorded timestamps stay monotonic (see module docstring).
+            "wall_anchor": time.time(),
+            "requests": reqs,
+        }
+
+
+# -- module-level recorder (one per process) --------------------------------
+
+_ENABLED = os.environ.get("LLMSS_TRACE", "1").lower() not in (
+    "0", "false", "off",
+)
+_RECORDER = FlightRecorder()
+
+
+def recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(on: bool) -> None:
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def record(req_id: str | None, name: str, **kw) -> None:
+    """Record one event for ``req_id``; no-op when tracing is disabled."""
+    if not _ENABLED or req_id is None:
+        return
+    _RECORDER.record(req_id, name, **kw)
+
+
+def span(req_id: str | None, name: str, **attrs) -> Span:
+    """A context-managed monotonic span; inert when tracing is disabled."""
+    if not _ENABLED or req_id is None:
+        return Span(None, req_id, name, attrs)
+    return _RECORDER.start_span(req_id, name, **attrs)
+
+
+def ensure_context(req) -> None:
+    """Stamp a ``trace_id`` on a GenerateRequest-shaped object if missing.
+
+    The trace id is the request id at first admission and survives
+    re-prefill (only ``trace_attempt`` bumps), so one timeline covers every
+    delivery attempt.
+    """
+    if getattr(req, "trace_id", None) is None:
+        req.trace_id = req.id
+
+
+# -- stitching --------------------------------------------------------------
+
+
+def normalize(export: dict) -> list[dict]:
+    """Flatten one process export to events with fleet-comparable
+    ``ts_wall`` timestamps (wall = wall_anchor + (t - mono_anchor))."""
+    base = export["wall_anchor"] - export["mono_anchor"]
+    out = []
+    for rid, blob in export.get("requests", {}).items():
+        for ev in blob["events"]:
+            e = dict(ev)
+            e.setdefault("proc", export.get("proc", "?"))
+            e["ts_wall"] = base + e["t"]
+            e["trace_id"] = blob.get("trace_id")
+            out.append(e)
+    return out
+
+
+def stitch(exports, req_id: str | None = None) -> list[dict]:
+    """Merge process exports into one wall-aligned timeline, deduplicating
+    events that reach the producer via more than one path (local recorder
+    AND a registry heartbeat from a worker in the same process)."""
+    seen = set()
+    evs = []
+    for ex in exports:
+        for e in normalize(ex):
+            if req_id is not None and e["req_id"] != req_id:
+                continue
+            key = (e["req_id"], e["name"], e["proc"], round(e["t"] * 1e6))
+            if key in seen:
+                continue
+            seen.add(key)
+            evs.append(e)
+    evs.sort(key=lambda e: e["ts_wall"])
+    return evs
+
+
+def phase_breakdown(events) -> dict[str, float]:
+    """Seconds attributed per phase: span durations summed by name, plus a
+    synthesized ``queue_wait`` (first enqueue → first lease gap)."""
+    tot: dict[str, float] = {}
+    for e in events:
+        d = e.get("dur")
+        if d:
+            tot[e["name"]] = tot.get(e["name"], 0.0) + d
+    enq = next((e for e in events if e["name"] == "enqueue"), None)
+    lease = next((e for e in events if e["name"] == "lease"), None)
+    if enq and lease and lease["ts_wall"] > enq["ts_wall"]:
+        tot["queue_wait"] = lease["ts_wall"] - enq["ts_wall"]
+    return tot
+
+
+def dominant_phase(events) -> str | None:
+    tot = phase_breakdown(events)
+    if not tot:
+        return None
+    return max(tot.items(), key=lambda kv: kv[1])[0]
+
+
+def timeline(exports, req_id: str) -> dict | None:
+    """The ``GET /trace/{req_id}`` body: stitched events + attribution."""
+    evs = stitch(exports, req_id)
+    if not evs:
+        return None
+    phases = phase_breakdown(evs)
+    return {
+        "req_id": req_id,
+        "trace_id": next(
+            (e["trace_id"] for e in evs if e.get("trace_id")), None,
+        ),
+        "total_s": round(evs[-1]["ts_wall"] - evs[0]["ts_wall"], 6),
+        "dominant_phase": dominant_phase(evs),
+        "phases": {k: round(v, 6) for k, v in sorted(phases.items())},
+        "events": evs,
+    }
+
+
+def slowest(exports, n: int = 10) -> list[dict]:
+    """Tail-latency attribution: the ``n`` slowest retained requests by
+    first-to-last event span, each with its dominant phase."""
+    by_req: dict[str, list[dict]] = {}
+    for e in stitch(exports):
+        by_req.setdefault(e["req_id"], []).append(e)
+    rows = []
+    for rid, evs in by_req.items():
+        phases = phase_breakdown(evs)
+        rows.append({
+            "req_id": rid,
+            "trace_id": next(
+                (e["trace_id"] for e in evs if e.get("trace_id")), None,
+            ),
+            "total_s": round(evs[-1]["ts_wall"] - evs[0]["ts_wall"], 6),
+            "dominant_phase": dominant_phase(evs),
+            "phases": {k: round(v, 6) for k, v in sorted(phases.items())},
+            "n_events": len(evs),
+        })
+    rows.sort(key=lambda r: r["total_s"], reverse=True)
+    return rows[:max(0, int(n))]
+
+
+def to_chrome_trace(exports, req_id: str | None = None) -> dict:
+    """Chrome trace-event JSON (loadable at ui.perfetto.dev): one pid per
+    process label, one tid per request, ``X`` complete events for spans and
+    ``i`` instants for point events, timestamps in microseconds."""
+    evs = stitch(exports, req_id)
+    out: list[dict] = []
+    pids: dict[str, int] = {}
+    tids: dict[tuple, int] = {}
+    t0 = evs[0]["ts_wall"] if evs else 0.0
+    for e in evs:
+        pid = pids.setdefault(e["proc"], len(pids) + 1)
+        tids.setdefault((e["proc"], e["req_id"]), len(tids) + 1)
+    for proc, pid in pids.items():
+        out.append({
+            "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": proc},
+        })
+    for (proc, rid), tid in tids.items():
+        out.append({
+            "ph": "M", "pid": pids[proc], "tid": tid, "name": "thread_name",
+            "args": {"name": rid},
+        })
+    for e in evs:
+        pid = pids[e["proc"]]
+        tid = tids[(e["proc"], e["req_id"])]
+        args = dict(e.get("attrs") or {})
+        if e.get("trace_id"):
+            args["trace_id"] = e["trace_id"]
+        ts = (e["ts_wall"] - t0) * 1e6
+        if e.get("dur") is not None:
+            out.append({
+                "ph": "X", "pid": pid, "tid": tid, "name": e["name"],
+                "cat": "span", "ts": ts - e["dur"] * 1e6,
+                "dur": e["dur"] * 1e6, "args": args,
+            })
+        else:
+            out.append({
+                "ph": "i", "pid": pid, "tid": tid, "name": e["name"],
+                "cat": "event", "ts": ts, "s": "t", "args": args,
+            })
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def chrome_trace_json(exports, req_id: str | None = None) -> str:
+    return json.dumps(to_chrome_trace(exports, req_id))

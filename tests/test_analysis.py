@@ -79,6 +79,17 @@ def timed(fn):
     fn()
     return time.time() - t0
 """,
+    "span-not-ended": """
+from llmss_tpu.utils import trace
+
+def handle(req):
+    span = trace.recorder().start_span(req.id, "prefill")
+    run_prefill(req)
+
+def fire_and_forget(req):
+    trace.recorder().start_span(req.id, "decode")
+    run_decode(req)
+""",
     "unguarded-write": """
 import threading
 
@@ -146,6 +157,79 @@ def expired(req):
     return req.deadline_ts is not None and time.time() > req.deadline_ts
 """)
     assert (code, findings) == (0, [])
+
+
+def test_wall_anchor_statements_are_exempt(tmp_path):
+    # The trace export's one-wall-read-per-process anchor is the other
+    # legal wall-clock site (cross-process stitching needs it); the same
+    # statement discipline as deadline_ts applies.
+    code, findings = lint(tmp_path, """
+import time
+
+def export(reqs):
+    return {"wall_anchor": time.time(), "mono_anchor": time.monotonic()}
+""")
+    assert (code, findings) == (0, [])
+    # The exemption is per-statement, not per-file.
+    code, findings = lint(tmp_path, """
+import time
+
+def export(reqs):
+    wall_anchor = time.time()
+    t0 = time.time()
+    return wall_anchor, t0
+""")
+    assert code == 1
+    assert [f.rule for f in findings] == ["wall-clock-timer"]
+    assert findings[0].line == 6
+
+
+def test_span_with_statement_and_finally_end_are_legal(tmp_path):
+    # The two blessed shapes: context manager, and try/finally .end().
+    code, findings = lint(tmp_path, """
+from llmss_tpu.utils import trace
+
+def ctx(req):
+    with trace.recorder().start_span(req.id, "prefill"):
+        run(req)
+
+def explicit(req):
+    span = trace.recorder().start_span(req.id, "decode")
+    try:
+        run(req)
+    finally:
+        span.end(ok=True)
+
+def factory(req):
+    # Returning the span hands lifetime to the caller — not a leak.
+    return trace.recorder().start_span(req.id, "adopt")
+""")
+    assert (code, findings) == (0, [])
+
+
+def test_span_ended_only_on_happy_path_flagged(tmp_path):
+    code, findings = lint(tmp_path, """
+from llmss_tpu.utils import trace
+
+def leaky(req):
+    span = trace.recorder().start_span(req.id, "decode")
+    run(req)  # raises -> span never ends
+    span.end()
+""")
+    # .end() after a statement that can raise is not a guaranteed
+    # position... but a straight-line body IS guaranteed to reach it, so
+    # this form passes; only branch-dependent ends are flagged.
+    assert (code, findings) == (0, [])
+    code, findings = lint(tmp_path, """
+from llmss_tpu.utils import trace
+
+def branchy(req, ok):
+    span = trace.recorder().start_span(req.id, "decode")
+    if ok:
+        span.end()
+""")
+    assert code == 1
+    assert {f.rule for f in findings} == {"span-not-ended"}
 
 
 def test_time_import_alias_tracked(tmp_path):

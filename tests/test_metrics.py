@@ -91,3 +91,68 @@ def test_engine_records_metrics(tmp_path, devices):
     assert m["tokens_generated"] == 5
     assert m["ttft"]["count"] == 1
     assert m["decode_step"]["count"] == 4
+
+def test_latency_stat_reservoir_spans_stream():
+    """Algorithm-R sampling: once the reservoir is full, retained samples
+    must span the whole stream rather than being a cyclic slice of the
+    most recent ``max_samples`` values (the old deterministic-stride
+    behavior). The rng is seeded from the stat name, so this is exact."""
+    s = LatencyStat("resv", max_samples=50)
+    for i in range(1000):
+        s.record(float(i))
+    assert len(s._samples) == 50
+    early = sum(1 for v in s._samples if v < 500.0)
+    # The stride sampler would keep only the tail (early == 0); a fair
+    # reservoir keeps ~half from the first half of the stream.
+    assert 10 <= early <= 40
+    d = s.to_dict()
+    assert set(d) == {"count", "mean_ms", "p50_ms", "p95_ms", "p99_ms"}
+    assert d["count"] == 1000
+
+
+def test_latency_stat_reservoir_deterministic():
+    a, b = LatencyStat("same", max_samples=20), LatencyStat("same", max_samples=20)
+    for i in range(300):
+        a.record(float(i))
+        b.record(float(i))
+    assert a._samples == b._samples
+    assert a.to_dict() == b.to_dict()
+
+
+def test_render_prometheus():
+    from llmss_tpu.utils.metrics import render_prometheus
+
+    payload = {
+        "requests_served": 3,
+        "ttft": {
+            "count": 2, "mean_ms": 5.0, "p50_ms": 4.0,
+            "p95_ms": 6.0, "p99_ms": 6.5,
+        },
+        "delivery": {"redelivered": 1, "handoff_bytes": 64},
+        "supervisor": {"state": "ready", "alive": True, "restarts": 0},
+        "fleet": {
+            "handoff_depth": 0,
+            "workers": {
+                "w0": {"queue_depth": 2, "free_slots": 4, "state": "ready"},
+                "w1": {"queue_depth": 0, "free_slots": 8, "state": "ready"},
+            },
+        },
+    }
+    text = render_prometheus(payload)
+    lines = text.splitlines()
+    assert "llmss_requests_served 3" in lines
+    # Latency dicts become a quantile family plus _count/_mean_ms.
+    assert "# TYPE llmss_ttft_ms gauge" in lines
+    assert 'llmss_ttft_ms{quantile="p50"} 4.0' in lines
+    assert 'llmss_ttft_ms{quantile="p99"} 6.5' in lines
+    assert "llmss_ttft_count 2" in lines
+    assert "llmss_ttft_mean_ms 5.0" in lines
+    assert "llmss_delivery_redelivered 1" in lines
+    # Fleet workers get a worker label instead of per-worker names.
+    assert 'llmss_fleet_worker_queue_depth{worker="w0"} 2' in lines
+    assert 'llmss_fleet_worker_free_slots{worker="w1"} 8' in lines
+    assert "llmss_fleet_handoff_depth 0" in lines
+    # Strings and bools are not Prometheus samples.
+    assert "ready" not in text and "alive" not in text
+    assert "llmss_supervisor_restarts 0" in lines
+    assert text.endswith("\n")

@@ -1,0 +1,492 @@
+"""End-to-end request tracing (``utils/trace.py``).
+
+The tentpole claims pinned here:
+
+- trace context (``trace_id`` + ``trace_attempt``) survives the wire and
+  the LKVH handoff on BOTH brokers, so ``GET /trace/{req_id}`` can
+  reconstruct the full producer → prefill → handoff → decode timeline;
+- a decode replica hard-killed mid-handoff leaves a complete flight
+  recorder timeline: the re-prefill keeps the SAME trace id with a bumped
+  attempt index, and the timeline ends in exactly one terminal event;
+- the Chrome trace export is valid JSON with per-process monotonically
+  consistent timestamps even under (simulated) cross-process clock skew —
+  the one-wall-anchor-per-export discipline is what makes that true;
+- tracing off records nothing, and tracing on adds zero steady-state
+  recompiles (the instrumentation is host-side only).
+"""
+
+import json
+import threading
+import time
+
+import httpx
+import pytest
+
+from llmss_tpu.serve.broker import InProcBroker, RedisBroker
+from llmss_tpu.serve.chaos import (
+    ChaosWorkerHost,
+    FakeRedis,
+    HardKill,
+    ScriptedEngine,
+)
+from llmss_tpu.serve.handoff import DecodeWorker, PrefillWorker
+from llmss_tpu.serve.producer import ProducerServer
+from llmss_tpu.serve.protocol import GenerateRequest
+from llmss_tpu.utils import trace
+from llmss_tpu.utils.trace import FlightRecorder
+
+BROKER_KINDS = ("inproc", "fakeredis")
+
+
+def make_brokers(kind, **kw):
+    """(producer-side broker, make_worker_broker(worker_id)) — the same
+    two deployment shapes tests/test_handoff.py exercises."""
+    if kind == "inproc":
+        b = InProcBroker(**kw)
+        return b, (lambda wid: b)
+    server = FakeRedis()
+
+    def mk(wid):
+        return RedisBroker(client=server, worker_id=wid, **kw)
+
+    return mk("producer"), mk
+
+
+@pytest.fixture(autouse=True)
+def clean_recorder():
+    """Each test starts from an empty process recorder with tracing on."""
+    trace.set_enabled(True)
+    trace.recorder().clear()
+    yield
+    trace.set_enabled(True)
+    trace.recorder().clear()
+
+
+# -- flight recorder unit behavior ------------------------------------------
+
+
+def test_recorder_ring_evicts_oldest_request():
+    rec = FlightRecorder(max_requests=2, proc="p")
+    rec.record("a", "enqueue")
+    rec.record("b", "enqueue")
+    rec.record("c", "enqueue")  # ring full: "a" (oldest) is evicted
+    assert rec.req_ids() == ["b", "c"]
+    rec.record("b", "lease")  # touching "b" makes "c" the eviction victim
+    rec.record("d", "enqueue")
+    assert rec.req_ids() == ["b", "d"]
+
+
+def test_recorder_sheds_group_spam_before_lifecycle_events():
+    rec = FlightRecorder(max_events=4, proc="p")
+    rec.record("r", "enqueue")
+    for _ in range(3):
+        rec.record("r", "group_fetch")
+    # At capacity a lifecycle event evicts a sheddable one, never the
+    # other way around...
+    rec.record("r", "respond")
+    names = [e["name"] for e in rec.events_for("r")]
+    assert names.count("group_fetch") == 2
+    assert names[0] == "enqueue" and names[-1] == "respond"
+    # ...and new sheddable events at capacity are simply dropped.
+    rec.record("r", "group_dispatch")
+    assert len(rec.events_for("r")) == 4
+    assert rec.export()["requests"]["r"]["dropped"] == 2
+
+
+def test_recorder_throttles_renewals():
+    rec = FlightRecorder(proc="p")
+    rec.record("r", "lease_renew", throttle_s=10.0)
+    rec.record("r", "lease_renew", throttle_s=10.0)
+    rec.record("r", "lease_renew", throttle_s=10.0)
+    assert len(rec.events_for("r")) == 1
+
+
+def test_span_records_duration_error_and_is_idempotent():
+    rec = FlightRecorder(proc="p")
+    with rec.start_span("r", "prefill", worker="w0"):
+        pass
+    ev = rec.events_for("r")[0]
+    assert ev["name"] == "prefill" and ev["dur"] >= 0.0
+    assert ev["attrs"]["worker"] == "w0"
+    with pytest.raises(RuntimeError):
+        with rec.start_span("r", "decode"):
+            raise RuntimeError("boom")
+    assert rec.events_for("r")[1]["attrs"]["error"] == "RuntimeError"
+    s = rec.start_span("r", "adopt")
+    s.end()
+    s.end()  # idempotent: one event, not two
+    assert len(rec.events_for("r")) == 3
+
+
+def test_export_budget_keeps_most_recent():
+    rec = FlightRecorder(proc="p")
+    for i in range(5):
+        rec.record(f"r{i}", "enqueue")
+    ex = rec.export(max_events=2)
+    assert set(ex["requests"]) == {"r4", "r3"}
+    assert "wall_anchor" in ex and "mono_anchor" in ex and ex["proc"] == "p"
+
+
+# -- trace context on the wire ----------------------------------------------
+
+
+def test_trace_context_survives_wire_roundtrip():
+    req = GenerateRequest(id="w1", token_ids=[1, 2])
+    trace.ensure_context(req)
+    assert req.trace_id == "w1"
+    rt = GenerateRequest.from_json(req.to_json())
+    assert rt.trace_id == "w1" and rt.trace_attempt == 0
+    # Pre-tracing payloads (no trace fields) still parse: wire-compatible.
+    d = json.loads(req.to_json())
+    d.pop("trace_id")
+    d.pop("trace_attempt")
+    old = GenerateRequest.from_json(json.dumps(d))
+    assert old.trace_id is None and old.trace_attempt == 0
+
+
+# -- end-to-end propagation across the handoff ------------------------------
+
+
+def _run_to_completion(b, workers, reqs, timeout_s=20.0):
+    got = {}
+    deadline = time.monotonic() + timeout_s
+    while len(got) < len(reqs) and time.monotonic() < deadline:
+        for w in workers:
+            w.run_once()
+        for r in reqs:
+            if r.id not in got:
+                resp = b.wait_response(r.id, timeout=0.01)
+                if resp is not None:
+                    got[r.id] = resp
+    return got
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_trace_propagates_producer_to_decode(kind):
+    b, mk = make_brokers(kind, lease_s=2.0)
+    pre = PrefillWorker(ScriptedEngine(), mk("p0"), worker_id="p0")
+    dec = DecodeWorker(ScriptedEngine(), mk("d0"), worker_id="d0")
+    reqs = [
+        GenerateRequest(id=f"t{i}", token_ids=[5 + i, 3], max_new_tokens=4)
+        for i in range(2)
+    ]
+    for r in reqs:
+        b.push_request(r)
+    got = _run_to_completion(b, [pre, dec], reqs)
+    assert len(got) == len(reqs)
+
+    exports = [trace.recorder().export()]
+    for r in reqs:
+        tl = trace.timeline(exports, r.id)
+        assert tl is not None and tl["trace_id"] == r.id
+        names = [e["name"] for e in tl["events"]]
+        # The full disaggregated path, in one stitched timeline.
+        for expected in (
+            "enqueue", "lease", "prefill", "handoff_push",
+            "handoff_lease", "decode", "respond",
+        ):
+            assert expected in names, (r.id, expected, names)
+        assert names.count("respond") == 1
+        assert names[-1] == "respond"
+        assert {e["trace_id"] for e in tl["events"]} == {r.id}
+        assert tl["phases"].get("queue_wait", 0.0) >= 0.0
+        assert tl["dominant_phase"] is not None
+
+
+# -- the acceptance chaos case ----------------------------------------------
+
+
+class _KillOnAdopt(ScriptedEngine):
+    """Decode-engine stand-in whose first N adoptions are machine death:
+    HardKill escapes mid-adopt with the handoff lease still open."""
+
+    def __init__(self, kills: int):
+        super().__init__()
+        self._kills_left = kills
+        self._klock = threading.Lock()
+
+    def adopt_generate(self, *a, **kw):
+        with self._klock:
+            if self._kills_left > 0:
+                self._kills_left -= 1
+                raise HardKill("chaos: decode replica died mid-adopt")
+        return super().adopt_generate(*a, **kw)
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_chaos_kill_decode_mid_handoff_timeline(kind):
+    """A decode replica hard-dies after leasing a handoff record. The
+    lease expires, the broker re-prefills the request — same trace_id,
+    bumped attempt index — and the flight recorder shows the complete
+    story ending in exactly one terminal event."""
+    b, mk = make_brokers(kind, lease_s=0.25, max_delivery_attempts=6)
+    eng = _KillOnAdopt(2)  # shared across respawns: exactly 2 deaths
+    pre = ChaosWorkerHost(
+        lambda: PrefillWorker(
+            ScriptedEngine(), mk("p0"), worker_id="p0",
+            poll_timeout_s=0.02,
+        ),
+        respawn_delay_s=0.02,
+    )
+    dec = ChaosWorkerHost(
+        lambda: DecodeWorker(
+            eng, mk("d0"), worker_id="d0", poll_timeout_s=0.02,
+        ),
+        respawn_delay_s=0.02,
+    )
+    reqs = [
+        GenerateRequest(
+            id=f"c{i}", token_ids=[i + 2, 9], max_new_tokens=4,
+            deadline_ts=time.time() + 30.0,
+        )
+        for i in range(4)
+    ]
+    pre.start()
+    dec.start()
+    try:
+        for r in reqs:
+            b.push_request(r)
+        for r in reqs:
+            resp = b.wait_response(r.id, timeout=20.0)
+            assert resp is not None, f"lost {r.id}"
+            assert resp.error is None, (r.id, resp.error)
+            assert resp.token_ids == ScriptedEngine.expected_tokens(
+                list(r.token_ids), r.max_new_tokens,
+            )
+            assert b.wait_response(r.id, timeout=0.05) is None, (
+                f"duplicate terminal response for {r.id}"
+            )
+    finally:
+        pre.stop()
+        dec.stop()
+    assert pre.error is None and dec.error is None
+    assert dec.kills == 2
+
+    exports = [trace.recorder().export()]
+    n_reprefills = 0
+    for r in reqs:
+        tl = trace.timeline(exports, r.id)
+        assert tl is not None and tl["trace_id"] == r.id
+        names = [e["name"] for e in tl["events"]]
+        terminals = [n for n in names if n in trace.TERMINAL_EVENTS]
+        assert terminals == ["respond"], (r.id, names)
+        assert names[-1] == "respond"
+        reps = [e for e in tl["events"] if e["name"] == "reprefill"]
+        for i, e in enumerate(reps, start=1):
+            # Re-prefill stays inside the ORIGINAL request's timeline:
+            # same trace id, attempt index bumped per re-prefill.
+            assert e["trace_id"] == r.id
+            assert e["attrs"]["attempt"] == i
+        n_reprefills += len(reps)
+    assert n_reprefills == 2
+    assert b.delivery_stats()["reprefills"] == 2
+
+
+# -- cross-process stitching under clock skew --------------------------------
+
+
+def _skewed_exports():
+    """Two process exports whose monotonic epochs are wildly different
+    (1000s vs 50s) and whose wall anchors disagree by 200 ms — the
+    stitcher must align them purely through the per-export anchors."""
+    ex_a = {
+        "proc": "pA", "mono_anchor": 1000.0, "wall_anchor": 5000.0,
+        "requests": {"r": {"trace_id": "r", "dropped": 0, "events": [
+            {"req_id": "r", "name": "enqueue", "t": 999.0},
+            {"req_id": "r", "name": "lease", "t": 999.5},
+        ]}},
+    }
+    ex_b = {
+        "proc": "pB", "mono_anchor": 50.0, "wall_anchor": 5000.2,
+        "requests": {"r": {"trace_id": "r", "dropped": 0, "events": [
+            {"req_id": "r", "name": "prefill", "t": 49.9, "dur": 0.4},
+            {"req_id": "r", "name": "respond", "t": 49.95},
+        ]}},
+    }
+    return [ex_a, ex_b]
+
+
+def test_stitch_aligns_across_clock_skew():
+    evs = trace.stitch(_skewed_exports())
+    assert [e["name"] for e in evs] == [
+        "enqueue", "lease", "prefill", "respond",
+    ]
+    phases = trace.phase_breakdown(evs)
+    assert abs(phases["queue_wait"] - 0.5) < 1e-9
+    assert abs(phases["prefill"] - 0.4) < 1e-9
+    assert trace.dominant_phase(evs) == "queue_wait"
+    tl = trace.timeline(_skewed_exports(), "r")
+    assert abs(tl["total_s"] - 1.15) < 1e-6
+    rows = trace.slowest(_skewed_exports(), n=3)
+    assert rows[0]["req_id"] == "r"
+    assert rows[0]["dominant_phase"] == "queue_wait"
+
+
+def test_stitch_dedups_double_delivered_events():
+    # The same export arriving twice (local recorder + registry
+    # heartbeat) must not duplicate the timeline.
+    ex = _skewed_exports()[0]
+    assert len(trace.stitch([ex, ex])) == 2
+
+
+def test_chrome_trace_export_valid():
+    exports = _skewed_exports()
+    doc = json.loads(trace.chrome_trace_json(exports))  # valid JSON
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    assert {e["ph"] for e in evs} <= {"M", "X", "i"}
+    procs = {
+        e["args"]["name"] for e in evs
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert procs == {"pA", "pB"}
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == 1 and abs(xs[0]["dur"] - 0.4e6) < 1.0
+    assert all(e["ts"] >= 0 for e in evs if e["ph"] in ("X", "i"))
+    assert all(e["s"] == "t" for e in evs if e["ph"] == "i")
+
+    # Per-process consistency: within one process the wall-aligned order
+    # must equal the monotonic order (the anchor is a pure offset).
+    by_proc: dict = {}
+    for e in trace.stitch(exports):
+        by_proc.setdefault(e["proc"], []).append(e)
+    for proc_evs in by_proc.values():
+        ts = [e["ts_wall"] for e in proc_evs]
+        mono = [e["t"] for e in proc_evs]
+        assert ts == sorted(ts) and mono == sorted(mono)
+
+
+# -- tracing off -------------------------------------------------------------
+
+
+def test_tracing_off_records_nothing():
+    trace.set_enabled(False)
+    b, mk = make_brokers("inproc", lease_s=2.0)
+    pre = PrefillWorker(ScriptedEngine(), mk("p0"), worker_id="p0")
+    dec = DecodeWorker(ScriptedEngine(), mk("d0"), worker_id="d0")
+    r = GenerateRequest(id="off", token_ids=[3, 4], max_new_tokens=3)
+    b.push_request(r)
+    got = _run_to_completion(b, [pre, dec], [r], timeout_s=10.0)
+    assert got and got["off"].token_ids
+    assert trace.recorder().req_ids() == []
+    with trace.span("off", "phase"):
+        pass
+    assert trace.recorder().req_ids() == []
+    # Heartbeat snapshots omit the trace blob entirely on the off path.
+    assert all("trace" not in info for info in b.read_workers().values())
+
+
+# -- producer endpoints ------------------------------------------------------
+
+
+def _seed_recorder():
+    trace.record("rq1", "enqueue", trace_id="rq1", queue="shared")
+    with trace.span("rq1", "prefill", trace_id="rq1", worker="w0"):
+        time.sleep(0.01)
+    trace.record("rq1", "respond", ok=True)
+
+
+def test_producer_trace_and_prometheus_endpoints():
+    b = InProcBroker()
+    srv = ProducerServer(b, host="127.0.0.1", port=0, timeout_s=5.0)
+    srv.start()
+    try:
+        _seed_recorder()
+        base = f"http://127.0.0.1:{srv.port}"
+        tl = httpx.get(f"{base}/trace/rq1").json()
+        assert tl["req_id"] == "rq1" and tl["trace_id"] == "rq1"
+        assert [e["name"] for e in tl["events"]][-1] == "respond"
+        assert "prefill" in tl["phases"]
+
+        sl = httpx.get(f"{base}/trace/slowest?n=5").json()["slowest"]
+        assert sl and sl[0]["req_id"] == "rq1"
+
+        ch = httpx.get(f"{base}/trace/rq1?format=chrome").json()
+        assert any(e.get("ph") == "X" for e in ch["traceEvents"])
+
+        assert httpx.get(f"{base}/trace/nope").status_code == 404
+
+        r = httpx.get(f"{base}/metrics")  # JSON stays the default
+        assert r.headers["content-type"].startswith("application/json")
+        assert "delivery" in r.json()
+
+        r = httpx.get(f"{base}/metrics?format=prometheus")
+        assert r.status_code == 200
+        assert r.headers["content-type"].startswith("text/plain")
+        assert "# TYPE" in r.text and "llmss_delivery_" in r.text
+    finally:
+        srv.stop()
+
+
+def test_profile_endpoint_serializes_captures(tmp_path):
+    from llmss_tpu.serve import producer as producer_mod
+
+    b = InProcBroker()
+    srv = ProducerServer(b, host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        r = httpx.post(f"{base}/profile", json={
+            "log_dir": str(tmp_path / "prof"), "duration_s": 0.3,
+        })
+        assert r.status_code == 202
+        body = r.json()
+        assert body["profiling"] is True and body["duration_s"] == 0.3
+        # One capture per process: an overlapping request is refused.
+        r2 = httpx.post(f"{base}/profile", json={"duration_s": 0.1})
+        assert r2.status_code == 409
+        deadline = time.monotonic() + 10.0
+        while producer_mod._PROFILE_LOCK.locked():
+            assert time.monotonic() < deadline, "profile never finished"
+            time.sleep(0.05)
+    finally:
+        srv.stop()
+
+
+# -- tracing on adds zero steady-state recompiles ----------------------------
+
+import jax  # noqa: E402
+
+from llmss_tpu.engine import DecodeEngine, GenerationParams  # noqa: E402
+from llmss_tpu.engine.scheduler import ContinuousBatcher  # noqa: E402
+from llmss_tpu.models.common import DecoderConfig  # noqa: E402
+from llmss_tpu.models.decoder import init_params  # noqa: E402
+from llmss_tpu.parallel import MeshPlan, make_mesh  # noqa: E402
+
+
+def test_tracing_adds_no_steady_state_recompiles(devices):
+    """The instrumentation is host-side only: with tracing ON and traced
+    req_ids flowing through the scheduler, a warmed batcher must hit the
+    jit caches exactly as before — zero new compiles."""
+    from llmss_tpu.analysis import CompileGuard
+
+    cfg = DecoderConfig(
+        model_type="llama", vocab_size=64, hidden_size=32, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=8, intermediate_size=64,
+        max_position_embeddings=64, activation="silu", norm="rmsnorm",
+        norm_eps=1e-5, mlp="swiglu", positions="rotary", rope_style="half",
+        rotary_dim=8, attn_bias=False, mlp_bias=False,
+        tie_word_embeddings=False, dtype="float32",
+    )
+    mesh = make_mesh(MeshPlan(dp=2, tp=4))
+    params = init_params(cfg, mesh, jax.random.key(0))
+    engine = DecodeEngine(cfg, params, mesh, max_seq_len=64)
+    batcher = ContinuousBatcher(
+        engine, rows=2, chunk_steps=2, group_chunks=2,
+    )
+    batcher.prewarm()
+    gen = GenerationParams(max_new_tokens=4, is_greedy=True)
+
+    guard = CompileGuard.for_engine(engine)
+    assert guard._fns, "engine exposes no jitted callables to guard"
+    got = {}
+    with guard.steady_state():
+        for i, p in enumerate([[5, 9], [3, 14, 15]]):
+            batcher.submit(
+                p, gen, lambda t, i=i: got.__setitem__(i, t),
+                req_id=f"g{i}",
+            )
+        batcher.run_until_idle()
+    assert len(got) == 2
+    names = {e["name"] for e in trace.recorder().events_for("g0")}
+    assert {"sched_submit", "admit", "finish"} <= names
