@@ -137,6 +137,10 @@ def run_window(worker, broker, make_req, rate: float, seconds: float,
         # host pays dispatch+fetch+callback once per GROUP, not per
         # chunk — host_syncs/groups_dispatched here is exactly 1.0.
         "host_overhead": host_overhead_breakdown(engine.metrics),
+        # Mixed-batch composition (all zeros unless the worker ran with
+        # chunked prefill): decode vs prompt row-steps per ragged group
+        # and how full the chunk budget ran.
+        "mixed_batch": m["mixed_batch"],
     }
 
 

@@ -209,6 +209,15 @@ class DecodeEngine:
             donate_argnums=(1, 2, 3),
             static_argnames=("n_chunks", "n_steps", "t_bucket"),
         )
+        # Ragged mixed prefill+decode group (chunked prefill): each scan
+        # step advances decode rows by one token AND streams chunk-budget
+        # slices of in-flight prompts through the same dispatch
+        # (forward_ragged). Executable identity is keyed purely by the xs
+        # shapes [n_chunks, B(, CB)] — no static args, no bucket ladder.
+        self._ragged_group = jax.jit(
+            partial(self._ragged_group_impl, cfg, mesh),
+            donate_argnums=(1, 2, 3),
+        )
         self._admit_merge = jax.jit(
             self._admit_merge_impl, donate_argnums=(0, 1)
         )
@@ -525,6 +534,100 @@ class DecodeEngine:
         carry, (toks, pois) = jax.lax.scan(
             chunk, (tokens, cache, cur_pos, done, poisoned0), None,
             length=n_chunks,
+        )
+        tokens, cache, cur_pos, done, _ = carry
+        packed = jnp.concatenate(
+            [toks.reshape(-1), pois.astype(jnp.int32).reshape(-1)]
+        )
+        return packed, tokens, cache, cur_pos, done
+
+    @staticmethod
+    def _ragged_step_body(cfg, mesh, params, sample_args, eos, carry, xs):
+        """One ragged mixed prefill+decode step (chunked prefill,
+        ISSUE 10): every row carries a CB-token query chunk of which
+        ``q_lens[b]`` are live. Decode rows run with ``q_len == 1``,
+        ``feed == False`` (the carried token is the input) and ``emit ==
+        True`` — for them the positions/slots/counters arithmetic below
+        reduces exactly to ``_decode_step_body``'s, so their token streams
+        match the split decode path. Mid-prefill rows feed prompt slices
+        (``feed == True``) and suppress sampling until the chunk that
+        completes the prompt (``emit`` flips on): the token sampled there
+        — at counter ``cur_pos + q_len`` = prompt length, the prefill
+        counter — is the row's first token, exactly what the dedicated
+        prefill program would have produced."""
+        from llmss_tpu.models.decoder import forward_ragged
+        from llmss_tpu.ops.sampling import fold_step_outcome
+
+        tokens, cache, cur_pos, done, poisoned = carry
+        ids, q_lens, feed, emit = xs
+        CB = ids.shape[1]
+        # Decode rows consume the device-resident carry token; prefill
+        # rows consume the host-fed prompt slice.
+        ids = ids.at[:, 0].set(jnp.where(feed, ids[:, 0], tokens))
+        rel = jnp.arange(CB, dtype=jnp.int32)
+        positions = cur_pos[:, None] + rel[None, :]
+        valid = rel[None, :] < q_lens[:, None]
+        live = valid & ~done[:, None]
+        # Dead columns (chunk padding / done rows) write nowhere: slot
+        # goes positive-OOB and position -1 — same containment as the
+        # decode step's done-row handling (docs/paged-kv.md).
+        slots = jnp.where(live, positions % cache.max_len, cache.max_len)
+        kv_pos = jnp.where(live, positions, -1)
+        logits, cache = forward_ragged(
+            cfg, params, ids, positions, cache, slots, q_lens,
+            kv_write_positions=kv_pos, mesh=mesh,
+        )
+        tok = sample(logits[:, 0], counters=cur_pos + q_lens, **sample_args)
+        tok, done2, poisoned = fold_step_outcome(
+            logits[:, 0], tok, done, poisoned, eos
+        )
+        # Mid-prefill rows emit nothing this step: keep the carried token
+        # and done state (a garbage mid-prompt sample must not EOS the
+        # row). Poison is cumulative regardless — non-finite logits in
+        # any chunk condemn the row.
+        tok = jnp.where(emit, tok, tokens)
+        done = jnp.where(emit, done2, done)
+        cur_pos = cur_pos + q_lens
+        return (tok, cache, cur_pos, done, poisoned), tok
+
+    @staticmethod
+    def _ragged_group_impl(
+        cfg, mesh, params, tokens, cache, cur_pos, sample_args, done,
+        eos, ids_seq, qlens_seq, feed_seq, emit_seq,
+    ):
+        """A GROUP of ragged mixed steps as one program — the chunked-
+        prefill twin of ``_decode_group_impl``. ``ids_seq`` [nc, B, CB],
+        ``qlens_seq``/``feed_seq``/``emit_seq`` [nc, B] are host-planned
+        per-step chunk schedules (which rows feed prompt slices, which
+        decode). One packed int32 transfer returns ``nc·B`` tokens then
+        ``nc·B`` cumulative poison snapshots — same layout as the decode
+        group at ``n_steps == 1``, so the scheduler's group processing is
+        shared. Returns ``(packed, last_tok, cache, cur_pos, done)``."""
+        body = partial(
+            DecodeEngine._ragged_step_body, cfg, mesh, params, sample_args,
+            eos,
+        )
+        # Pin the stacked ys replicated — same GSPMD partial-sum hazard
+        # as _decode_group_impl.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = (
+            NamedSharding(mesh, PartitionSpec()) if mesh is not None
+            else None
+        )
+        pin = (
+            (lambda x: jax.lax.with_sharding_constraint(x, rep))
+            if rep is not None else (lambda x: x)
+        )
+
+        def step(carry, xs):
+            carry, tok = body(carry, xs)
+            return carry, (pin(tok), pin(carry[4]))
+
+        poisoned0 = jnp.zeros_like(done)
+        carry, (toks, pois) = jax.lax.scan(
+            step, (tokens, cache, cur_pos, done, poisoned0),
+            (ids_seq, qlens_seq, feed_seq, emit_seq),
         )
         tokens, cache, cur_pos, done, _ = carry
         packed = jnp.concatenate(

@@ -110,13 +110,20 @@ class _InFlightGroup:
     # previous group and this one, so this group's fetch-to-fetch interval
     # is not a clean decode-only sample.
     has_admission: bool = False
+    # Ragged mixed group (chunked prefill): for each row whose prompt
+    # completed inside this group, the chunk index whose sampled token is
+    # the request's FIRST token — admission bookkeeping happens at that
+    # chunk in _process_group (chunked admissions never create an
+    # _InFlightAdmission). Rows absent from the map either finished
+    # streaming earlier or are still mid-prompt (skip their chunks).
+    prefill_firsts: dict | None = None
 
 
 class ContinuousBatcher:
     def __init__(
         self, engine: DecodeEngine, *, rows: int = 8, chunk_steps: int = 1,
         chunk_steps_low: int | None = None, group_chunks: int = 1,
-        prefill_only: bool = False,
+        prefill_only: bool = False, chunked_prefill: int | None = None,
     ):
         # chunk_steps > 1 advances all rows that many tokens per scheduler
         # step (one fused scan instead of per-token dispatch); combined
@@ -174,6 +181,31 @@ class ContinuousBatcher:
         if prefill_only and engine.kv_layout != "paged":
             raise ValueError("prefill_only requires kv_layout='paged'")
         self.prefill_only = prefill_only
+        # Chunked prefill (docs/decode-loop.md): prompts admit WITHOUT a
+        # dedicated prefill program — they stream through the ragged
+        # mixed-batch dispatch (DecodeEngine._ragged_group) as extra query
+        # rows, ``chunked_prefill`` tokens per step, alongside the decode
+        # rows advancing one token each. The prefill bucket ladder and its
+        # (P × S) prewarm grid die with the dedicated program, and a long
+        # prompt admits across O(len/budget) *shared* steps instead of one
+        # monolithic prefill that stalls every decode row for seconds.
+        # Paged-only: admission is a table upload + positions merge (the
+        # pool IS the scratch); the dense path would still need a row copy.
+        if chunked_prefill is not None:
+            if chunked_prefill < 1:
+                raise ValueError(
+                    f"chunked_prefill must be >= 1, got {chunked_prefill}"
+                )
+            if engine.kv_layout != "paged":
+                raise ValueError(
+                    "chunked_prefill requires kv_layout='paged'"
+                )
+        self.chunked_prefill = chunked_prefill
+        self._chunked = chunked_prefill is not None
+        # row -> remaining prompt tokens to feed / total prompt length
+        # (worker-thread state, like ``active``).
+        self._inflight_prefill: dict[int, list[int]] = {}
+        self._prefill_plen: dict[int, int] = {}
         # Called as export_cb(req_id, first_token, n_tokens, blocks) with
         # ``blocks`` the export_blocks() host-array dict; set by the
         # serving layer before submitting.
@@ -505,11 +537,32 @@ class ContinuousBatcher:
             p *= 2
         Ps.append(p)  # one above, for n == rows when rows isn't a pow2
         n_compiled = 0
+        if self._chunked and prefix_prefill:
+            # build_prefix still runs through the ENGINE's own _prefill jit
+            # at batch=1 even under chunked prefill (prefix construction is
+            # a one-off dense prefill, not an admission) — warm it per
+            # bucket so the first prefix build doesn't compile mid-serve.
+            sa1 = eng._sample_args(GenerationParams(), 1)
+            for S in seq_buckets:
+                c1 = eng.new_cache(1)
+                _, _, c1 = eng._prefill(
+                    eng.params, jnp.zeros((1, S), np.int32), c1,
+                    jnp.ones(1, np.int32), sa1,
+                )
+                del c1
+                n_compiled += 1
         for P in sorted(set(Ps)):
             sa = eng._sample_args(GenerationParams(), P)
             scratch = None
-            tok = None
-            for S in seq_buckets:
+            tok = jnp.zeros(P, jnp.int32)
+            # Chunked prefill KILLS the (P × S) admission-prefill grid:
+            # prompts stream through the ragged dispatch, so no dedicated
+            # prefill executable exists to warm — only the per-P positions
+            # merge + device-state merge below, and the ragged combos
+            # after the decode loop. The steady-state executable count
+            # collapses to the two grouped-decode combos (× buckets) plus
+            # the two ragged step counts (tests/test_ragged.py asserts).
+            for S in seq_buckets if not self._chunked else []:
                 scratch = self._prewarm_scratch(P)
                 ids = jnp.zeros((P, S), np.int32)
                 lens = jnp.ones(P, np.int32)
@@ -583,6 +636,29 @@ class ContinuousBatcher:
                     jnp.ones(self.rows, bool),
                     jnp.full(self.rows, -1, np.int32),
                     n_chunks=nc, n_steps=k, t_bucket=tb,
+                )
+                self.cache = eng.canon_cache(cache)
+                self._cur_pos_dev = eng.canon_vec(cur_pos)
+                self._tokens_dev = eng.canon_vec(last_tok)
+                n_compiled += 1
+        if self._chunked:
+            # The ragged mixed-batch programs — one per live step count
+            # (busy and low-load). All-done dummy schedules: no KV writes
+            # land (live = valid & ~done), but the executable for each
+            # live xs shape [nc, rows, CB] compiles.
+            CB = self.chunked_prefill
+            for nc in sorted({
+                self.group_chunks * self.chunk_steps, self.chunk_steps_low,
+            }):
+                _, last_tok, cache, cur_pos, _ = eng._ragged_group(
+                    eng.params, self._tokens_dev, self.cache,
+                    self._cur_pos_dev, sa,
+                    jnp.ones(self.rows, bool),
+                    jnp.full(self.rows, -1, np.int32),
+                    jnp.zeros((nc, self.rows, CB), jnp.int32),
+                    jnp.ones((nc, self.rows), jnp.int32),
+                    jnp.zeros((nc, self.rows), bool),
+                    jnp.ones((nc, self.rows), bool),
                 )
                 self.cache = eng.canon_cache(cache)
                 self._cur_pos_dev = eng.canon_vec(cur_pos)
@@ -734,6 +810,9 @@ class ContinuousBatcher:
         P = 1
         while P < n:
             P *= 2
+        if self._chunked:
+            self._admit_chunked(taken, rows, P, head_prefix)
+            return None
         plen = head_prefix.length if head_prefix is not None else 0
         # With a prefix, only each request's suffix is padded/prefilled.
         suffixes = [
@@ -837,66 +916,130 @@ class ContinuousBatcher:
             entries.append((rows[i], r))
         return _InFlightAdmission(entries=entries, tok=tok)
 
+    def _admit_chunked(
+        self, taken: list, rows: list[int], P: int, head_prefix,
+    ) -> None:
+        """Chunked-prefill admission: NO prefill program runs. The rows'
+        blocks are already reserved (``_paged_reserve``) and their tables
+        staged host-side; admission is one table upload, one positions
+        merge (seeding prefix rows' shared-FULL-block positions, clearing
+        everything else to -1), and one device-state merge pointing
+        ``cur_pos`` at the feed start. The prompt itself streams through
+        the next ragged groups, ``chunked_prefill`` tokens per step.
+
+        Prefix rows resume after the shared full blocks (``start = ns·bs``)
+        and re-feed the COW partial tail through the ragged steps — its KV
+        lands in the row's first owned block, exactly where the dedicated
+        prefill's copy-on-write would put it."""
+        eng = self.engine
+        n = len(taken)
+        row_idx = self._pad_row_idx(P, rows)
+        ns = (
+            len(self._row_shared[rows[0]]) if head_prefix is not None else 0
+        )
+        start = ns * eng.block_size
+        sub = np.full((P, eng.max_seq_len), -1, np.int32)
+        sub[:n, :start] = np.arange(start, dtype=np.int32)[None, :]
+        self.cache = eng.canon_cache(self.cache._replace(
+            block_tables=self._dev_tables(self._host_tables),
+            positions=self._merge_positions(
+                self.cache.positions, eng.canon_vec(jnp.asarray(sub)),
+                jnp.asarray(row_idx),
+            ),
+        ))
+        starts = np.ones(P, np.int32)
+        starts[:n] = start
+        # Carry token 0 is never read: every planned chunk of these rows
+        # feeds prompt slices until emit flips on.
+        self._tokens_dev, self._cur_pos_dev = (
+            eng.canon_vec(x) for x in eng._admit_merge(
+                self._tokens_dev, self._cur_pos_dev,
+                eng.canon_vec(jnp.zeros(P, jnp.int32)),
+                jnp.asarray(starts), jnp.asarray(row_idx),
+            )
+        )
+        for i, (req_id, ids, gen, cb, scb, t_submit, _pfx) in enumerate(
+            taken
+        ):
+            r = _Row(
+                req_id=req_id, gen=gen, out=[], done_cb=cb, stream_cb=scb,
+                awaiting_first=True, t_submit=t_submit,
+            )
+            self.active[rows[i]] = r
+            self._row_pos[rows[i]] = start
+            self._inflight_prefill[rows[i]] = list(ids[start:])
+            self._prefill_plen[rows[i]] = len(ids)
+
     def _resolve_admission(self, adm: _InFlightAdmission | None) -> int:
         """Host bookkeeping for a dispatched admission (fetch its first
         tokens — by now overlapped with at least one decode chunk)."""
         if adm is None:
             return 0
         firsts = np.asarray(adm.tok)
-        now = time.perf_counter()
         n = 0
         for i, (row, r) in enumerate(adm.entries):
             if self.active.get(row) is not r:
                 continue  # cancelled (and possibly re-admitted) meanwhile
-            # TTFT spans submit → resolve: queueing for a free row, the
-            # admission prefill, AND the decode chunk the admission
-            # deliberately overlapped — the time a client actually waited
-            # for its first token.
-            self.engine.metrics.ttft.record(now - r.t_submit)
-            self.engine.metrics.add_request(1)
-            if r.req_id:
-                # "admit" (not "prefill"): its duration is submit→first
-                # token — queue wait + prefill + overlapped chunk — while
-                # the role worker's "prefill" span times only the export
-                # call; distinct names keep phase sums from double-counting.
-                trace.record(
-                    r.req_id, "admit", dur_s=now - r.t_submit
-                )
-            r.awaiting_first = False
+            self._resolve_first(row, r, int(firsts[i]))
             n += 1
-            first = int(firsts[i])
-            eos = (
-                r.gen.eos_token_id if r.gen.eos_token_id is not None else -1
-            )
-            if first == eos or r.gen.max_new_tokens == 0:
-                self._finish(row, r)
-                continue
-            if self.prefill_only and r.gen.max_new_tokens > 1:
-                # Disaggregated prefill: export the row's blocks and free
-                # it — the decode replica owns the request from here.
-                # (max_new == 1 falls through: the first token IS the
-                # answer, shipping KV for it would be pure overhead.)
-                self._export_row(row, r, first)
-                continue
-            r.out.append(first)
-            self.engine.metrics.add_tokens(1)
-            if len(r.out) >= r.gen.max_new_tokens:
-                self._finish(row, r)
-            else:
-                # First token goes out now, not a full chunk later —
-                # streaming's perceived TTFT is the point.
-                self._flush_stream(r)
         return n
 
-    def _export_row(self, row: int, r: _Row, first: int) -> None:
+    def _resolve_first(self, row: int, r: _Row, first: int) -> None:
+        """Host bookkeeping at a request's FIRST token — shared by the
+        admission-prefill resolve and the ragged chunked path (there the
+        first token arrives in the chunk that completed the prompt)."""
+        now = time.perf_counter()
+        # TTFT spans submit → resolve: queueing for a free row, the
+        # admission prefill (or the chunked prompt streaming), AND the
+        # decode work the admission deliberately overlapped — the time a
+        # client actually waited for its first token.
+        self.engine.metrics.ttft.record(now - r.t_submit)
+        self.engine.metrics.add_request(1)
+        if r.req_id:
+            # "admit" (not "prefill"): its duration is submit→first
+            # token — queue wait + prefill + overlapped chunk — while
+            # the role worker's "prefill" span times only the export
+            # call; distinct names keep phase sums from double-counting.
+            trace.record(r.req_id, "admit", dur_s=now - r.t_submit)
+        r.awaiting_first = False
+        eos = (
+            r.gen.eos_token_id if r.gen.eos_token_id is not None else -1
+        )
+        if first == eos or r.gen.max_new_tokens == 0:
+            self._finish(row, r)
+            return
+        if self.prefill_only and r.gen.max_new_tokens > 1:
+            # Disaggregated prefill: export the row's blocks and free
+            # it — the decode replica owns the request from here.
+            # (max_new == 1 falls through: the first token IS the
+            # answer, shipping KV for it would be pure overhead.)
+            self._export_row(
+                row, r, first, n_tokens=self._prefill_plen.get(row)
+            )
+            return
+        r.out.append(first)
+        self.engine.metrics.add_tokens(1)
+        if len(r.out) >= r.gen.max_new_tokens:
+            self._finish(row, r)
+        else:
+            # First token goes out now, not a full chunk later —
+            # streaming's perceived TTFT is the point.
+            self._flush_stream(r)
+
+    def _export_row(
+        self, row: int, r: _Row, first: int, n_tokens: int | None = None,
+    ) -> None:
         """Prefill-only epilogue for one admitted row: copy its blocks to
         host (a pure pool read — COW-shared prefix blocks stay shared and
         refcounted for the NEXT request; ``export_blocks`` zeroes slot
         garbage past ``n_tokens``), free the row, then hand the payload
         to ``export_cb``. Freeing first means an export_cb that throws
         can't leak the row; the host copy is complete before the blocks
-        return to the pool, so reuse can't corrupt it."""
-        n_tokens = self._row_pos[row]
+        return to the pool, so reuse can't corrupt it. ``n_tokens`` is the
+        prompt length — passed explicitly on the chunked path, where
+        ``_row_pos`` has already advanced past it by plan time."""
+        if n_tokens is None:
+            n_tokens = self._row_pos[row]
         bs = self.engine.block_size
         nb = -(-n_tokens // bs)
         blk_ids = self._host_tables[row, :nb].copy()
@@ -904,6 +1047,8 @@ class ContinuousBatcher:
         cb = self.export_cb
         self.active.pop(row, None)
         self._row_pos.pop(row, None)
+        self._inflight_prefill.pop(row, None)
+        self._prefill_plen.pop(row, None)
         self._paged_release_row(row)
         with self._lock:
             self._free.append(row)
@@ -1046,6 +1191,8 @@ class ContinuousBatcher:
     ) -> None:
         self.active.pop(row, None)
         self._row_pos.pop(row, None)
+        self._inflight_prefill.pop(row, None)
+        self._prefill_plen.pop(row, None)
         self._paged_release_row(row)
         with self._lock:
             self._free.append(row)
@@ -1164,6 +1311,8 @@ class ContinuousBatcher:
         self._pending_adm = None
         self._last_fetch_t = None
         self._row_pos.clear()
+        self._inflight_prefill.clear()
+        self._prefill_plen.clear()
         for row in list(self.active):
             r = self.active.pop(row)
             ids.append(r.req_id)
@@ -1237,11 +1386,32 @@ class ContinuousBatcher:
 
         n = 0
         t_cb = time.perf_counter()
+        firsts = group.prefill_firsts or {}
         for c in range(nc):
             for i in list(self.active):
                 r = self.active[i]
                 if r.awaiting_first:
-                    continue  # admitted after this group was dispatched
+                    first_c = firsts.get(i)
+                    if first_c is None or c < first_c:
+                        # Mid-prompt (or admitted after this group was
+                        # dispatched): nothing to consume yet.
+                        continue
+                    # The chunk that completed this row's prompt — its
+                    # sampled token is the request's FIRST token; admission
+                    # bookkeeping happens here (chunked admissions never
+                    # create an _InFlightAdmission). Poison first: a NaN
+                    # anywhere in the prompt condemns the row before its
+                    # garbage first token reads as a clean answer.
+                    if poisoned_np[c, i]:
+                        self.engine.metrics.add_poisoned(1)
+                        self._finish(
+                            i, r,
+                            error="non-finite logits: row poisoned "
+                                  "(NaN/inf in model output)",
+                        )
+                        continue
+                    self._resolve_first(i, r, int(toks_np[c, i, 0]))
+                    continue
                 if poisoned_np[c, i]:
                     # Checked BEFORE token processing: the device
                     # EOS-filled the poisoned row from the bad step on
@@ -1281,6 +1451,43 @@ class ContinuousBatcher:
         self.engine.metrics.add_tokens(n)
         self.engine.metrics.host_callback.record(time.perf_counter() - t_cb)
         return n
+
+    def _plan_ragged(self, n_steps: int):
+        """Host-side schedule for one ragged mixed group: every active row
+        advances one token per step; rows with an in-flight prompt feed
+        ``chunked_prefill``-token slices instead, sampling suppressed
+        until the slice that completes the prompt (``emit`` flips on —
+        that step's sample is the row's first token). A row whose prompt
+        completes mid-group decodes normally for the remaining steps.
+        Returns the xs arrays plus {row: step} first-token marks."""
+        CB, R = self.chunked_prefill, self.rows
+        ids = np.zeros((n_steps, R, CB), np.int32)
+        qlens = np.ones((n_steps, R), np.int32)
+        feed = np.zeros((n_steps, R), bool)
+        emit = np.ones((n_steps, R), bool)
+        firsts: dict[int, int] = {}
+        fed = 0
+        for s in range(n_steps):
+            for row in list(self._inflight_prefill):
+                rem = self._inflight_prefill[row]
+                q = min(CB, len(rem))
+                ids[s, row, :q] = rem[:q]
+                del rem[:q]
+                qlens[s, row] = q
+                feed[s, row] = True
+                emit[s, row] = not rem
+                fed += q
+                if not rem:
+                    firsts[row] = s
+                    del self._inflight_prefill[row]
+        pre = int(feed.sum())
+        self.engine.metrics.add_mixed_steps(
+            steps=n_steps,
+            decode_rows=n_steps * len(self.active) - pre,
+            prefill_rows=pre, prefill_tokens=fed,
+            budget_tokens=pre * CB,
+        )
+        return ids, qlens, feed, emit, firsts
 
     def step(self) -> int:
         """One scheduler iteration of the pipelined loop:
@@ -1325,26 +1532,66 @@ class ContinuousBatcher:
 
         done, eos_arr, sa = self._chunk_args()
         busy = len(self.active) >= (3 * self.rows) // 4
-        # Busy → the full group of full chunks (host off the critical
-        # path); low load → one short chunk (admission/TTFT granularity).
-        # Exactly these two (n_chunks, n_steps) combos exist, so the
-        # executable envelope stays two programs per cache-read bucket —
-        # same count as the ungrouped two-chunk-size scheme.
-        nc, k = (
-            (self.group_chunks, self.chunk_steps) if busy
-            else (1, self.chunk_steps_low)
-        )
-        t_bucket = self.engine.decode_bucket(
-            max(self._row_pos.values(), default=0) + nc * k
-        )
         t0 = time.perf_counter()
-        packed, last_tok, cache, cur_pos, _ = self.engine._decode_group(
-            self.engine.params, self._tokens_dev, self.cache,
-            self._cur_pos_dev, sa, jnp.asarray(done), jnp.asarray(eos_arr),
-            n_chunks=nc, n_steps=k, t_bucket=t_bucket,
-        )
-        for row in self._row_pos:
-            self._row_pos[row] += nc * k
+        if self._chunked and self._inflight_prefill:
+            # Mixed batch: in-flight prompts stream through the ragged
+            # dispatch as chunk-budget query rows while decode rows
+            # advance one token per step. No t_bucket — the ragged
+            # executable's identity is keyed purely by the xs shapes, so
+            # exactly TWO programs exist (the busy and low-load step
+            # counts). The group never records decode_step (it is not a
+            # clean decode-only sample — has_admission covers that).
+            nc, k = (
+                self.group_chunks * self.chunk_steps if busy
+                else self.chunk_steps_low
+            ), 1
+            ids_seq, qlens_seq, feed_seq, emit_seq, firsts = (
+                self._plan_ragged(nc)
+            )
+            packed, last_tok, cache, cur_pos, _ = self.engine._ragged_group(
+                self.engine.params, self._tokens_dev, self.cache,
+                self._cur_pos_dev, sa, jnp.asarray(done),
+                jnp.asarray(eos_arr), jnp.asarray(ids_seq),
+                jnp.asarray(qlens_seq), jnp.asarray(feed_seq),
+                jnp.asarray(emit_seq),
+            )
+            adv = qlens_seq.sum(axis=0)
+            for row in self._row_pos:
+                self._row_pos[row] += int(adv[row])
+            group = _InFlightGroup(
+                packed=packed, n_chunks=nc, k=k, has_admission=True,
+                prefill_firsts=firsts,
+            )
+        else:
+            # Busy → the full group of full chunks (host off the critical
+            # path); low load → one short chunk (admission/TTFT
+            # granularity). Exactly these two (n_chunks, n_steps) combos
+            # exist, so the executable envelope stays two programs per
+            # cache-read bucket — same count as the ungrouped
+            # two-chunk-size scheme.
+            nc, k = (
+                (self.group_chunks, self.chunk_steps) if busy
+                else (1, self.chunk_steps_low)
+            )
+            t_bucket = self.engine.decode_bucket(
+                max(self._row_pos.values(), default=0) + nc * k
+            )
+            packed, last_tok, cache, cur_pos, _ = self.engine._decode_group(
+                self.engine.params, self._tokens_dev, self.cache,
+                self._cur_pos_dev, sa, jnp.asarray(done),
+                jnp.asarray(eos_arr),
+                n_chunks=nc, n_steps=k, t_bucket=t_bucket,
+            )
+            for row in self._row_pos:
+                self._row_pos[row] += nc * k
+            # The admission dispatched LAST step sits between the previous
+            # group and this one on the device queue, so this group's
+            # fetch-to-fetch interval includes its prefill+insert+merge
+            # time.
+            group = _InFlightGroup(
+                packed=packed, n_chunks=nc, k=k,
+                has_admission=self._pending_adm is not None,
+            )
         self.cache = self.engine.canon_cache(cache)
         self._cur_pos_dev = self.engine.canon_vec(cur_pos)
         self._tokens_dev = self.engine.canon_vec(last_tok)
@@ -1360,13 +1607,6 @@ class ContinuousBatcher:
                     r.req_id, "group_dispatch", throttle_s=0.05,
                     chunks=nc, k=k,
                 )
-        # The admission dispatched LAST step sits between the previous
-        # group and this one on the device queue, so this group's
-        # fetch-to-fetch interval includes its prefill+insert+merge time.
-        group = _InFlightGroup(
-            packed=packed, n_chunks=nc, k=k,
-            has_admission=self._pending_adm is not None,
-        )
 
         prev, self._inflight = self._inflight, group
         n = 0

@@ -43,6 +43,8 @@ from llmss_tpu.ops.attention import (
     fresh_kv_window_attention,
     make_causal_mask,
     paged_decode_attention,
+    ragged_cache_visibility,
+    ragged_paged_attention,
     window_mask_penalty,
 )
 from llmss_tpu.ops.layers import (
@@ -1073,6 +1075,230 @@ def _forward_paged(
             )
 
     logits = _head_out(cfg, params, h, gather_idx, last_only, _ablate)
+    return logits, PagedKVCache(
+        k=k_new, v=v_new, block_tables=cache.block_tables,
+        positions=new_kv_positions, k_scale=ks_new, v_scale=vs_new,
+    )
+
+
+def _make_ragged_kernel_attn(
+    cfg, mesh, cache, positions0, q_lens, slot0, nblk,
+):
+    """Ragged analogue of ``_make_paged_kernel_attn``: returns a
+    ``(q, k_new, v_new, k_cache, v_cache, *, layer) -> attn`` callable
+    running the mixed prefill+decode block-table kernel
+    (ops/pallas_ragged.py), or None — the XLA gather fallback
+    (``ops.attention.ragged_paged_attention``) stays the implementation
+    and the parity oracle.
+
+    Same opt-in contract as the paged decode kernel: only under
+    ``LLMSS_ATTN_IMPL=pallas``, with a warning fallback when shapes leave
+    the kernel envelope so A/B runs never silently measure the XLA path.
+    """
+    import importlib
+
+    from llmss_tpu.ops import pallas_ragged
+
+    attention_mod = importlib.import_module("llmss_tpu.ops.attention")
+    force = attention_mod.IMPL_OVERRIDE
+    if mesh is None or force != "pallas":
+        return None
+    dp, sp, tp = (
+        mesh.shape[AXIS_DP], mesh.shape[AXIS_SP], mesh.shape[AXIS_TP]
+    )
+    B = cache.block_tables.shape[0]
+    Hq, Hkv, D = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    kv_shard, heads_ok, kv_ax = attention_mod.tp_head_plan(Hq, Hkv, tp)
+    local_Hq = Hq // tp
+    local_Hkv = Hkv // tp if kv_shard else Hkv
+    if sp != 1 or B % dp or not heads_ok or not pallas_ragged.supports(
+        cache.block_size, local_Hq, local_Hkv, D
+    ):
+        import warnings
+
+        warnings.warn(
+            "LLMSS_ATTN_IMPL=pallas: shapes out of the ragged mixed-batch "
+            f"kernel envelope (sp={sp}, B={B}, dp={dp}, "
+            f"bs={cache.block_size}, Hq={Hq}, Hkv={Hkv}, D={D}); mixed "
+            "batches run the XLA gather path",
+            stacklevel=2,
+        )
+        return None
+    qs = P(AXIS_DP, None, AXIS_TP, None)
+    pool_s = P(None, None, None, kv_ax, None)
+    kns = P(AXIS_DP, None, kv_ax, None)
+    ps = P(AXIS_DP, None)
+    row = P(AXIS_DP)
+    interp = jax.default_backend() != "tpu"
+
+    def local(q, kp, vp, kn, vn, qp, ql, kvp, bt, nb, sl0, layer):
+        return pallas_ragged.ragged_paged_attention(
+            q, kp, vp, kn, vn, qp, ql, kvp, bt, nb, sl0, layer,
+            scale=cfg.attn_scale, window=cfg.sliding_window,
+            interpret=interp,
+        )
+
+    sharded = compat_shard_map(
+        local, mesh=mesh,
+        in_specs=(
+            qs, pool_s, pool_s, kns, kns, row, row, ps, ps, row, row, P()
+        ),
+        out_specs=qs, check_vma=False,
+    )
+
+    def attn(q, k_new, v_new, k_cache, v_cache, *, layer):
+        del k_cache, v_cache  # reads the stacked pool directly
+        return sharded(
+            q, cache.k, cache.v, k_new, v_new, positions0, q_lens,
+            cache.positions, cache.block_tables, nblk, slot0, layer,
+        )
+
+    return attn
+
+
+def forward_ragged(
+    cfg: DecoderConfig,
+    params: Params,
+    input_ids: jax.Array,  # [B, CB] — ragged chunks, q_lens live per row
+    positions: jax.Array,  # [B, CB] — row's first query at positions[:, 0]
+    cache: PagedKVCache,
+    slots: jax.Array,  # [B, CB] LOGICAL slots; max_len marks dead columns
+    q_lens: jax.Array,  # [B] int32 — 1 for decode rows, up to CB mid-prefill
+    *,
+    kv_write_positions: jax.Array | None = None,  # [B, CB]; -1 = no write
+    mesh=None,
+    t_bucket: int | None = None,
+) -> tuple[jax.Array, PagedKVCache]:
+    """Mixed prefill+decode forward over the paged pool: every row carries
+    a ``CB``-token query chunk of which the first ``q_lens[b]`` are live —
+    1 for rows mid-decode, more for rows streaming a prompt through
+    chunked prefill. One dispatch serves both phases, so prefill compute
+    is metered per step instead of monopolizing a dedicated (P, S)
+    prefill program (ISSUE 10; "Ragged Paged Attention", PAPERS.md).
+
+    Deferred-write structure exactly like the S == 1 decode branch of
+    ``_forward_paged``: attention runs over the stale pool (ragged Pallas
+    kernel reading blocks in place, or per-row gathered logical views
+    through the XLA oracle), and the chunk's fresh KV lands in one batched
+    all-layer pool scatter after the scan. Logits gather at each row's
+    last live chunk position (``q_lens - 1``) — for a prompt's final chunk
+    that is the prefill sampling position, for a decode row it is the
+    usual last-token gather. Padding columns (``>= q_lens``) write nowhere
+    (slots carry ``max_len``, positions −1) and their hidden states are
+    never gathered.
+    """
+    dtype = cfg.compute_dtype
+    del dtype  # same compute-dtype flow as _forward_paged via _block
+    h = _embed_in(cfg, params, input_ids, positions, mesh)
+
+    if kv_write_positions is None:
+        kv_write_positions = positions
+    new_kv_positions = write_positions(
+        cache.positions, kv_write_positions, slots
+    )
+
+    B, S = input_ids.shape
+    bs, MB = cache.block_size, cache.max_blocks
+    quant = cache.quantized
+
+    sin_cos = None
+    if cfg.positions == "rotary":
+        sin_cos = sin_cos_tables(
+            positions, cfg.rotary_dim or cfg.head_dim, cfg.rope_theta,
+            cfg.rope_freq_factors, cfg.rope_attn_factor,
+        )
+
+    # Bucketed pool read, same caller contract as _forward_paged.
+    nb = None
+    if t_bucket is not None and t_bucket < cache.max_len:
+        nb = min(-(-t_bucket // bs), MB)
+    Tv = (nb if nb is not None else MB) * bs
+    kv_pos_src = cache.positions[:, :Tv]
+
+    q_pos0 = positions[:, 0]
+    slot0 = slots[:, 0]
+
+    kernel_attn = None
+    if not quant:
+        occ = jnp.sum((cache.positions >= 0).astype(jnp.int32), axis=1)
+        nblk = jnp.clip(-(-occ // bs), 0, MB).astype(jnp.int32)
+        kernel_attn = _make_ragged_kernel_attn(
+            cfg, mesh, cache, q_pos0, q_lens, slot0, nblk
+        )
+
+    if kernel_attn is not None:
+        def body(h, xs):
+            bp, layer = xs
+            h, k_f, v_f = _block(
+                cfg, bp, h, positions, None, None, kv_pos_src, slots,
+                None, mesh=mesh, defer_write=True,
+                attn_override=partial(kernel_attn, layer=layer),
+                sin_cos=sin_cos,
+            )
+            return h, (k_f, v_f)
+
+        h, ys = jax.lax.scan(
+            body, h,
+            (params["blocks"], jnp.arange(cfg.n_layers, dtype=jnp.int32)),
+        )
+    else:
+        # Hoist the query-invariant visibility out of the layer scan (the
+        # per-query causal bound stays inside the oracle — it is chunk
+        # structure, not a [B, T] penalty).
+        cache_vis = ragged_cache_visibility(
+            q_lens, kv_pos_src, slot0, cache.max_len
+        )
+
+        def body(h, xs):
+            if quant:
+                bp, kp_l, vp_l, ksp_l, vsp_l = xs
+            else:
+                bp, kp_l, vp_l = xs
+                ksp_l = vsp_l = None
+
+            def ragged_attn(q, k_new, v_new, k_c, v_c):
+                del k_c, v_c  # reads the per-layer pool slice
+                return ragged_paged_attention(
+                    q, kp_l, vp_l, k_new, v_new, q_pos0, q_lens,
+                    kv_pos_src, cache.block_tables, slot0, cache.max_len,
+                    scale=cfg.attn_scale, window=cfg.sliding_window,
+                    cache_vis=cache_vis, k_scale_layer=ksp_l,
+                    v_scale_layer=vsp_l, n_blocks=nb,
+                )
+
+            h, k_f, v_f = _block(
+                cfg, bp, h, positions, None, None, kv_pos_src, slots,
+                None, mesh=mesh, defer_write=True,
+                attn_override=ragged_attn, sin_cos=sin_cos,
+            )
+            return h, (k_f, v_f)
+
+        if quant:
+            xs = (params["blocks"], cache.k, cache.v, cache.k_scale,
+                  cache.v_scale)
+        else:
+            xs = (params["blocks"], cache.k, cache.v)
+        h, ys = jax.lax.scan(body, h, xs)
+
+    ks_new, vs_new = cache.k_scale, cache.v_scale
+    k_fresh, v_fresh = ys  # [L, B, CB, Hkv, D]
+    if quant:
+        k_fresh, ks_f = quantize_kv(k_fresh)
+        v_fresh, vs_f = quantize_kv(v_fresh)
+        ks_new = paged_write_stacked(
+            cache.k_scale, ks_f, cache.block_tables, slots, bs
+        )
+        vs_new = paged_write_stacked(
+            cache.v_scale, vs_f, cache.block_tables, slots, bs
+        )
+    k_new = paged_write_stacked(
+        cache.k, k_fresh, cache.block_tables, slots, bs
+    )
+    v_new = paged_write_stacked(
+        cache.v, v_fresh, cache.block_tables, slots, bs
+    )
+
+    logits = _head_out(cfg, params, h, q_lens - 1, False)
     return logits, PagedKVCache(
         k=k_new, v=v_new, block_tables=cache.block_tables,
         positions=new_kv_positions, k_scale=ks_new, v_scale=vs_new,

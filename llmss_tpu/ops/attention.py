@@ -362,6 +362,158 @@ def paged_decode_attention(
     )
 
 
+def ragged_cache_visibility(
+    q_len: jax.Array,  # [B] — live query rows per chunk (1..S)
+    kv_pos_old: jax.Array,  # [B, T] — pre-write slot positions
+    slot0: jax.Array,  # [B] or [B, 1] — logical slot of the first query
+    ring_len: int,  # logical ring capacity (cache.max_len)
+) -> jax.Array:
+    """Query-invariant [B, T] bool cache visibility for
+    ``ragged_fresh_kv_attention``: a slot is a candidate iff it holds a
+    live token and is not among the chunk's ``q_len`` pending slots — the
+    ring range starting at ``slot0``, which the chunk's deferred write
+    overwrites (at ``q_len == 1`` this is ``decode_mask_penalty``'s
+    ``slot_idx != slot`` exclusion). The per-query causal bound is applied
+    on top by the core, since mid-prefill chunks carry intra-chunk causal
+    structure a single [B, T] penalty cannot express. Layer-invariant —
+    compute once per step and pass to every layer."""
+    B, T = kv_pos_old.shape
+    slot0 = slot0.reshape(B, 1)
+    slot_idx = jnp.arange(T, dtype=jnp.int32)
+    d = slot_idx[None, :] - slot0  # [B, T]
+    d = jnp.where(d < 0, d + ring_len, d)
+    pending = d < q_len[:, None]  # [B, T]
+    return (kv_pos_old >= 0) & ~pending
+
+
+def ragged_fresh_kv_attention(
+    q: jax.Array,  # [B, S, Hq, D] — S = chunk budget, ragged per q_len
+    k_cache: jax.Array,  # [B, T, Hkv, D] — stale (chunk NOT written)
+    v_cache: jax.Array,
+    k_new: jax.Array,  # [B, S, Hkv, D] — the chunk's own fresh KV
+    v_new: jax.Array,
+    q_pos: jax.Array,  # [B] or [B, 1] — FIRST query's absolute position
+    q_len: jax.Array,  # [B] — live query rows (1..S); rest are padding
+    kv_pos_old: jax.Array,  # [B, T] — pre-write slot positions
+    slot0: jax.Array,  # [B] or [B, 1] — logical slot of the first query
+    ring_len: int,
+    *,
+    scale: float | None = None,
+    window: int | None = None,
+    cache_vis: jax.Array | None = None,  # [B, T] bool — hoisted base mask
+    k_scale: jax.Array | None = None,  # [B, T, Hkv] f32 — int8 cache scales
+    v_scale: jax.Array | None = None,
+) -> jax.Array:
+    """Deferred-write attention for a ragged mixed prefill+decode batch:
+    one exact softmax over the stale cache plus each row's fresh
+    ``q_len``-token chunk. Generalizes ``fresh_kv_window_attention`` from
+    the uniform speculative window to per-row raggedness — the causal
+    bound varies per query row inside the chunk, the pending-slot
+    exclusion covers the chunk's ring range, and the intra-chunk
+    triangular mask is clipped at ``q_len`` so padding query rows (``i >=
+    q_len``) still attend fresh key 0 and keep a positive denominator (no
+    NaN; their outputs are garbage the head gather never reads). This is
+    the XLA gather oracle the ragged Pallas kernel
+    (ops/pallas_ragged.py) is parity-tested against. Int8 scales fold
+    exactly as in ``fresh_kv_decode_attention``."""
+    B, S, Hq, D = q.shape
+    T, Hkv = k_cache.shape[1], k_cache.shape[2]
+    G = Hq // Hkv
+    if scale is None:
+        scale = 1.0 / (D**0.5)
+    q_pos = q_pos.reshape(B, 1)
+    rel = jnp.arange(S, dtype=jnp.int32)
+    qpos = q_pos + rel[None, :]  # [B, S] — per-query absolute positions
+
+    if cache_vis is None:
+        cache_vis = ragged_cache_visibility(
+            q_len, kv_pos_old, slot0, ring_len
+        )
+    mask = cache_vis[:, None, :] & (
+        kv_pos_old[:, None, :] <= qpos[:, :, None]
+    )  # [B, S, T]
+    if window is not None:
+        mask &= kv_pos_old[:, None, :] > qpos[:, :, None] - window
+
+    qf = q.astype(jnp.float32).reshape(B, S, Hkv, G, D) * scale
+    s_c = jnp.einsum("bskgd,btkd->bkgst", qf, k_cache.astype(jnp.float32))
+    if k_scale is not None:
+        s_c = s_c * k_scale.transpose(0, 2, 1)[:, :, None, None, :]
+    s_c = jnp.where(mask[:, None, None], s_c, _NEG_INF)
+    # Intra-chunk scores: fresh key j visible to query i iff j <= i and
+    # j < q_len (key 0 ends up visible to every row, padding included).
+    s_w = jnp.einsum(
+        "bskgd,btkd->bkgst", qf, k_new.astype(jnp.float32)
+    )  # [B, Hkv, G, S, S]
+    tri = (rel[None, :, None] >= rel[None, None, :]) & (
+        rel[None, None, :] < q_len[:, None, None]
+    )  # [B, S(query), S(key)]
+    if window is not None:
+        tri &= (rel[None, :, None] - rel[None, None, :]) < window
+    s_w = jnp.where(tri[:, None, None], s_w, _NEG_INF)
+
+    m = jnp.maximum(
+        jnp.max(s_c, axis=-1, keepdims=True),
+        jnp.max(s_w, axis=-1, keepdims=True),
+    )
+    p_c = jnp.exp(s_c - m)
+    p_w = jnp.exp(s_w - m)
+    denom = (
+        jnp.sum(p_c, axis=-1, keepdims=True)
+        + jnp.sum(p_w, axis=-1, keepdims=True)
+    )
+    p_cv = p_c
+    if v_scale is not None:
+        p_cv = p_c * v_scale.transpose(0, 2, 1)[:, :, None, None, :]
+    out = (
+        jnp.einsum("bkgst,btkd->bkgsd", p_cv, v_cache.astype(jnp.float32))
+        + jnp.einsum("bkgst,btkd->bkgsd", p_w, v_new.astype(jnp.float32))
+    ) / denom
+    return (
+        out.transpose(0, 3, 1, 2, 4).reshape(B, S, Hq, D).astype(q.dtype)
+    )
+
+
+def ragged_paged_attention(
+    q: jax.Array,  # [B, CB, Hq, D]
+    k_pool_layer: jax.Array,  # [N, bs, Hkv, D] — one layer of the block pool
+    v_pool_layer: jax.Array,
+    k_new: jax.Array,  # [B, CB, Hkv, D]
+    v_new: jax.Array,
+    q_pos: jax.Array,  # [B] or [B, 1]
+    q_len: jax.Array,  # [B]
+    kv_pos_old: jax.Array,  # [B, nb*bs] — pre-write LOGICAL slot positions
+    block_tables: jax.Array,  # [B, MB] int32 (sentinel >= N = unmapped)
+    slot0: jax.Array,  # [B] or [B, 1]
+    ring_len: int,
+    *,
+    scale: float | None = None,
+    window: int | None = None,
+    cache_vis: jax.Array | None = None,  # [B, nb*bs] bool — hoisted mask
+    k_scale_layer: jax.Array | None = None,  # [N, bs, Hkv] f32 iff int8
+    v_scale_layer: jax.Array | None = None,
+    n_blocks: int | None = None,  # bucketed read: first n_blocks table cols
+) -> jax.Array:
+    """Ragged chunked attention, XLA gather fallback: materialize the
+    row-indirected logical view of one pool layer (``gather_block_view``)
+    and run the exact ragged fresh-KV merged softmax over it — the parity
+    oracle for the ragged Pallas kernel (ops/pallas_ragged.py) and the
+    path mixed batches take when the kernel envelope doesn't apply."""
+    from llmss_tpu.engine.cache import gather_block_view
+
+    k_view = gather_block_view(k_pool_layer, block_tables, n_blocks)
+    v_view = gather_block_view(v_pool_layer, block_tables, n_blocks)
+    ks = vs = None
+    if k_scale_layer is not None:
+        ks = gather_block_view(k_scale_layer, block_tables, n_blocks)
+        vs = gather_block_view(v_scale_layer, block_tables, n_blocks)
+    return ragged_fresh_kv_attention(
+        q, k_view, v_view, k_new, v_new, q_pos, q_len, kv_pos_old, slot0,
+        ring_len, scale=scale, window=window, cache_vis=cache_vis,
+        k_scale=ks, v_scale=vs,
+    )
+
+
 def dispatch_attention(
     q: jax.Array,  # [B, S, Hq, D]
     k: jax.Array,  # [B, T, Hkv, D]

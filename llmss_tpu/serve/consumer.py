@@ -419,6 +419,7 @@ class ContinuousWorker:
         worker_id: str | None = None,
         snapshot_interval_s: float = 1.0,
         role: str = "unified",
+        chunked_prefill: int | None = None,
     ):
         from collections import deque
 
@@ -434,6 +435,7 @@ class ContinuousWorker:
             engine, rows=rows, chunk_steps=chunk_steps,
             chunk_steps_low=chunk_steps_low, group_chunks=group_chunks,
             prefill_only=(role == "prefill"),
+            chunked_prefill=chunked_prefill,
         )
         # Prefill role: requests currently inside the batcher, keyed by id,
         # so the export callback can attach the ORIGINAL request (sampling
@@ -881,6 +883,15 @@ def main(argv=None):
              "redelivered (poison-request quarantine)",
     )
     parser.add_argument(
+        "--chunked_prefill", type=int, default=None,
+        help="continuous batching only: admit prompts by streaming them "
+             "through the ragged mixed-batch dispatch, this many tokens "
+             "per step, instead of a dedicated bucketed prefill program — "
+             "long prompts stop stalling decode rows and the prefill "
+             "prewarm grid disappears (docs/decode-loop.md). Requires "
+             "--kv_layout paged",
+    )
+    parser.add_argument(
         "--role", choices=["unified", "prefill", "decode"],
         default="unified",
         help="disaggregated serving role (docs/serving.md): 'prefill' "
@@ -930,6 +941,11 @@ def main(argv=None):
             parser.error("--role prefill/decode requires --continuous")
         if args.kv_layout != "paged":
             parser.error("--role prefill/decode requires --kv_layout paged")
+    if args.chunked_prefill is not None:
+        if not args.continuous:
+            parser.error("--chunked_prefill requires --continuous")
+        if args.kv_layout != "paged":
+            parser.error("--chunked_prefill requires --kv_layout paged")
 
     from transformers import AutoTokenizer
 
@@ -966,6 +982,7 @@ def main(argv=None):
                 worker_id=args.worker_id,
                 snapshot_interval_s=args.snapshot_interval_s,
                 role=args.role,
+                chunked_prefill=args.chunked_prefill,
             )
         else:
             w = Worker(

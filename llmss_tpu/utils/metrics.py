@@ -121,6 +121,14 @@ class EngineMetrics:
         self.kv_blocks_total = 0  # guarded_by: self._lock
         self.kv_blocks_in_use = 0  # guarded_by: self._lock
         self.kv_block_evictions = 0  # guarded_by: self._lock
+        # Mixed-batch composition under chunked prefill: how the ragged
+        # dispatch's row-steps split between decode rows and in-flight
+        # prompt rows, and how full the per-row chunk budget runs.
+        self.mixed_steps = 0  # guarded_by: self._lock
+        self.mixed_decode_rows = 0  # guarded_by: self._lock
+        self.mixed_prefill_rows = 0  # guarded_by: self._lock
+        self.prefill_tokens_chunked = 0  # guarded_by: self._lock
+        self.chunk_budget_tokens = 0  # guarded_by: self._lock
         self._start = time.monotonic()
 
     def add_tokens(self, n: int) -> None:
@@ -166,6 +174,22 @@ class EngineMetrics:
         with self._lock:
             self.kv_block_evictions += n
 
+    def add_mixed_steps(
+        self, steps: int, decode_rows: int, prefill_rows: int,
+        prefill_tokens: int, budget_tokens: int,
+    ) -> None:
+        """One ragged mixed group was planned: ``steps`` ragged steps whose
+        row-steps split into ``decode_rows`` single-token rows and
+        ``prefill_rows`` chunk-fed prompt rows; ``prefill_tokens`` prompt
+        tokens actually streamed against a ``budget_tokens`` capacity
+        (prefill_rows × chunk budget)."""
+        with self._lock:
+            self.mixed_steps += steps
+            self.mixed_decode_rows += decode_rows
+            self.mixed_prefill_rows += prefill_rows
+            self.prefill_tokens_chunked += prefill_tokens
+            self.chunk_budget_tokens += budget_tokens
+
     def add_host_sync(self, n: int = 1) -> None:
         """A blocking device→host fetch crossed the link."""
         with self._lock:
@@ -188,6 +212,11 @@ class EngineMetrics:
                 self.kv_block_evictions,
             )
             syncs, groups = self.host_syncs, self.groups_dispatched
+            m_steps, m_dec, m_pre, m_tok, m_budget = (
+                self.mixed_steps, self.mixed_decode_rows,
+                self.mixed_prefill_rows, self.prefill_tokens_chunked,
+                self.chunk_budget_tokens,
+            )
         return {
             "uptime_s": round(uptime, 1),
             "requests_served": reqs,
@@ -209,6 +238,16 @@ class EngineMetrics:
                 "dispatch": self.host_dispatch.to_dict(),
                 "fetch": self.host_fetch.to_dict(),
                 "callback": self.host_callback.to_dict(),
+            },
+            "mixed_batch": {
+                "steps": m_steps,
+                "decode_rows": m_dec,
+                "prefill_rows": m_pre,
+                "prefill_tokens_chunked": m_tok,
+                "chunk_budget_tokens": m_budget,
+                "chunk_budget_utilization": (
+                    round(m_tok / m_budget, 4) if m_budget else None
+                ),
             },
             **(
                 {"speculative": self.spec_stats}
