@@ -28,6 +28,8 @@ RULES = {
     "jit-missing-donate": "cache-threading jit without donate_argnums",
     "wall-clock-timer": "time.time() used for a duration/timeout",
     "span-not-ended": "start_span() discarded or not ended on all paths",
+    "unbounded-metric-label": "metric series name/label built from a "
+    "per-request identifier",
     "unguarded-write": "write to a `# guarded_by:` attr outside its lock",
     "lock-order-cycle": "cycle in the lock-acquisition-order graph",
 }

@@ -32,6 +32,10 @@ Rules emitted here:
                            or never ``.end()``-ed on a guaranteed path (use
                            the context manager, or ``end()`` in a
                            ``finally``)
+``unbounded-metric-label`` a metric series name or label built from a
+                           per-request identifier (``req_id`` etc.) — every
+                           request mints a new series and the registry grows
+                           without bound
 """
 
 from __future__ import annotations
@@ -54,6 +58,14 @@ _STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding", "name"}
 #: each is a deliberate cross-process absolute-time anchor (see
 #: ``_check_wall_clock``). A wall-clock read anywhere else is a bug.
 _WALL_EXEMPT = frozenset({"deadline_ts", "wall_anchor"})
+
+#: Metric-registry lookups: the argument is a series *name* (or, for
+#: ``labels``, a label value) and must come from a bounded vocabulary.
+_METRIC_FUNCS = {"counter", "histogram", "labels"}
+#: Per-request identifiers. One series per request = unbounded registry.
+_UNBOUNDED_NAMES = frozenset(
+    {"req_id", "trace_id", "request_id", "prompt", "prompt_text"}
+)
 
 
 # --------------------------------------------------------------------------
@@ -767,6 +779,67 @@ class _SpanChecker(ast.NodeVisitor):
         super().generic_visit(node)
 
 
+# --------------------------------------------------------------------------
+# unbounded-metric-label
+# --------------------------------------------------------------------------
+
+def _unbounded_ref(node: ast.expr) -> ast.AST | None:
+    """First sub-expression referencing a per-request identifier, if any.
+
+    Walks the whole argument subtree, so f-strings, ``str(...)`` wraps and
+    ``+``-concatenation are all seen through.
+    """
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in _UNBOUNDED_NAMES:
+            return sub
+        if isinstance(sub, ast.Attribute) and sub.attr in _UNBOUNDED_NAMES:
+            return sub
+    return None
+
+
+class _MetricLabelChecker(ast.NodeVisitor):
+    """A windowed series keyed by a per-request value never aggregates:
+    each request mints a fresh ring, memory grows with traffic, and every
+    export ships the full registry. Series names and label values must come
+    from a bounded vocabulary; the identifier belongs in the *trace*
+    (``trace.record(req_id, ...)`` is fine — traces are per-request by
+    design and bounded by the recorder's ring)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: list[Finding] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        is_metric = isinstance(func, ast.Attribute) and (
+            func.attr in _METRIC_FUNCS
+        )
+        if is_metric:
+            for arg in [*node.args, *(kw.value for kw in node.keywords)]:
+                ref = _unbounded_ref(arg)
+                if ref is not None:
+                    self._flag(node, func.attr, ref)
+                    break
+        else:
+            # labels=... keyword on any metric-ish constructor
+            for kw in node.keywords:
+                if kw.arg == "labels":
+                    ref = _unbounded_ref(kw.value)
+                    if ref is not None:
+                        self._flag(node, "labels=", ref)
+                        break
+        self.generic_visit(node)
+
+    def _flag(self, node: ast.Call, where: str, ref: ast.AST) -> None:
+        self.findings.append(Finding(
+            "unbounded-metric-label", self.path, node.lineno,
+            node.col_offset,
+            f"`{where}` derives a series name/label from per-request value "
+            f"`{_unparse(ref)}` — one series per request grows the registry "
+            "without bound; use a bounded name and put the id in the trace",
+        ))
+
+
 def check_module(
     path: str, tree: ast.Module, reg: JitRegistry
 ) -> list[Finding]:
@@ -777,6 +850,10 @@ def check_module(
     span_checker = _SpanChecker(path)
     span_checker.visit(tree)
     findings.extend(span_checker.findings)
+
+    metric_checker = _MetricLabelChecker(path)
+    metric_checker.visit(tree)
+    findings.extend(metric_checker.findings)
 
     # analyse jitted function bodies defined in this module
     seen: set[tuple[str, int]] = set()

@@ -221,6 +221,9 @@ class ContinuousBatcher:
             self._host_tables = np.full((rows, mb), self._sentinel, np.int32)
             self._row_owned: dict[int, list[int]] = {}
             self._row_shared: dict[int, list[int]] = {}
+            # row -> monotonic reserve time: block-seconds cost attribution
+            # (blocks held x hold duration, charged at release).
+            self._row_reserve_t: dict[int, float] = {}
             # id(prefix) -> (prefix, full-block ids); the registry holds
             # one allocator ref per block so an idle prefix survives until
             # evicted to admit new work.
@@ -462,6 +465,7 @@ class ContinuousBatcher:
                 self.allocator.incref(shared)
             self._row_owned[row] = owned
             self._row_shared[row] = list(shared)
+            self._row_reserve_t[row] = time.monotonic()
             self._host_tables[row, :] = self._sentinel
             self._host_tables[row, :ns] = shared
             self._host_tables[row, ns:ns + len(owned)] = owned
@@ -477,20 +481,33 @@ class ContinuousBatcher:
         )
         return ok_items, ok_rows
 
-    def _paged_release_row(self, row: int) -> None:
+    def _paged_release_row(self, row: int) -> float:
         """Return a finished/cancelled row's blocks to the pool NOW (owned
         blocks free; shared prefix blocks decref). The device-side table
         stays stale until the next admission uploads tables — safe because
         done rows' KV writes are slot-suppressed on device
-        (DecodeEngine._decode_many_impl) and nobody reads a freed row."""
+        (DecodeEngine._decode_many_impl) and nobody reads a freed row.
+
+        Returns the row's block-seconds (blocks held x hold duration) for
+        per-request cost attribution; the cumulative also lands on the
+        engine's ``kv_block_seconds`` counter."""
         if not self._paged:
-            return
-        self.allocator.free(self._row_owned.pop(row, []))
-        self.allocator.free(self._row_shared.pop(row, []))
+            return 0.0
+        owned = self._row_owned.pop(row, [])
+        shared = self._row_shared.pop(row, [])
+        self.allocator.free(owned)
+        self.allocator.free(shared)
         self._host_tables[row, :] = self._sentinel
+        held = 0.0
+        t0 = self._row_reserve_t.pop(row, None)
+        n_blocks = len(owned) + len(shared)
+        if t0 is not None and n_blocks:
+            held = (time.monotonic() - t0) * n_blocks
+            self.engine.metrics.add_kv_block_seconds(held)
         self.engine.metrics.set_kv_blocks(
             in_use=self.allocator.blocks_in_use
         )
+        return held
 
     def _prewarm_scratch(self, P: int):
         """Admission scratch for prewarm. Paged: an all-sentinel VIEW over
@@ -1118,6 +1135,7 @@ class ContinuousBatcher:
             return False
         self._row_owned[row] = owned
         self._row_shared[row] = []
+        self._row_reserve_t[row] = time.monotonic()
         self._host_tables[row, :] = self._sentinel
         self._host_tables[row, :need] = owned
         eng.metrics.set_kv_blocks(in_use=self.allocator.blocks_in_use)
@@ -1193,16 +1211,22 @@ class ContinuousBatcher:
         self._row_pos.pop(row, None)
         self._inflight_prefill.pop(row, None)
         self._prefill_plen.pop(row, None)
-        self._paged_release_row(row)
+        kv_block_s = self._paged_release_row(row)
         with self._lock:
             self._free.append(row)
         self._flush_stream(r)
+        disposition = (
+            "error" if error is not None
+            else "cancelled" if cancelled else "served"
+        )
+        self.engine.metrics.add_finish(disposition)
         if r.req_id:
             trace.record(
                 r.req_id, "finish", tokens=len(r.out),
-                disposition=(
-                    "error" if error is not None
-                    else "cancelled" if cancelled else "served"
+                disposition=disposition,
+                **(
+                    {"kv_block_s": round(kv_block_s, 6)}
+                    if kv_block_s else {}
                 ),
             )
         if error is not None:

@@ -39,8 +39,39 @@ import queue
 import threading
 import time
 
-from llmss_tpu.serve.protocol import GenerateRequest, GenerateResponse
+from llmss_tpu.serve.protocol import (
+    GenerateRequest, GenerateResponse, prefix_hash,
+)
+from llmss_tpu.utils import metrics as metrics_mod
 from llmss_tpu.utils import trace
+
+
+def _enqueue_attrs(req: GenerateRequest) -> dict:
+    """Workload-replay attrs stamped on every enqueue event: enough for
+    ``trace.export_workload`` to reconstruct an arrival process (lengths
+    and prefix hash — never prompt text, which would leak user content
+    into the flight recorder)."""
+    a: dict = {}
+    if req.token_ids is not None:
+        a["plen"] = len(req.token_ids)
+    a["max_new"] = req.max_new_tokens
+    if req.prefix_token_ids:
+        a["prefix"] = prefix_hash(req.prefix_token_ids)
+    return a
+
+
+def _observe_cost(resp: GenerateResponse) -> None:
+    """Terminal-time cost attribution: derive this request's RequestCost
+    from the local recorder and feed the windowed SLO series — exactly
+    once per request, in the process that settles it (a chaos-killed
+    replica never reaches ``push_response``; the surviving disposition
+    path that answers the request lands here). No-op when tracing is
+    disabled, so ``LLMSS_TRACE=0`` keeps the whole plane silent."""
+    if not trace.enabled():
+        return
+    cost = trace.local_cost(resp.id, error=resp.error)
+    if cost is not None:
+        metrics_mod.observe_request_cost(cost)
 
 
 class Broker(abc.ABC):
@@ -403,7 +434,10 @@ class InProcBroker(Broker):
 
     def push_request_to(self, worker_id: str, req: GenerateRequest) -> None:
         trace.ensure_context(req)
-        trace.record(req.id, "enqueue", trace_id=req.trace_id, queue=worker_id)
+        trace.record(
+            req.id, "enqueue", trace_id=req.trace_id, queue=worker_id,
+            **_enqueue_attrs(req),
+        )
         with self._route_lock:
             q = self._routed.setdefault(worker_id, queue.Queue())
         q.put(req)
@@ -701,7 +735,10 @@ class InProcBroker(Broker):
 
     def push_request(self, req: GenerateRequest) -> None:
         trace.ensure_context(req)
-        trace.record(req.id, "enqueue", trace_id=req.trace_id, queue="shared")
+        trace.record(
+            req.id, "enqueue", trace_id=req.trace_id, queue="shared",
+            **_enqueue_attrs(req),
+        )
         self._requests.put(req)
 
     def pop_request(
@@ -858,7 +895,12 @@ class InProcBroker(Broker):
         trace.record(
             resp.id, "respond", ok=resp.error is None,
             **({"error": resp.error} if resp.error else {}),
+            **(
+                {"n_tokens": len(resp.token_ids)}
+                if resp.token_ids else {}
+            ),
         )
+        _observe_cost(resp)
         with self._lease_lock:
             self._leases.pop(resp.id, None)
             self._handoff_leases.pop(resp.id, None)
@@ -1009,7 +1051,10 @@ class RedisBroker(Broker):
 
     def push_request_to(self, worker_id: str, req: GenerateRequest) -> None:
         trace.ensure_context(req)
-        trace.record(req.id, "enqueue", trace_id=req.trace_id, queue=worker_id)
+        trace.record(
+            req.id, "enqueue", trace_id=req.trace_id, queue=worker_id,
+            **_enqueue_attrs(req),
+        )
         self._r.lpush(self._routed_key(worker_id), req.to_json())
 
     def routed_depths(self) -> dict:
@@ -1467,7 +1512,10 @@ class RedisBroker(Broker):
 
     def push_request(self, req: GenerateRequest) -> None:
         trace.ensure_context(req)
-        trace.record(req.id, "enqueue", trace_id=req.trace_id, queue="shared")
+        trace.record(
+            req.id, "enqueue", trace_id=req.trace_id, queue="shared",
+            **_enqueue_attrs(req),
+        )
         self._r.lpush(self._rq, req.to_json())
 
     def pop_request(
@@ -1510,7 +1558,12 @@ class RedisBroker(Broker):
         trace.record(
             resp.id, "respond", ok=resp.error is None,
             **({"error": resp.error} if resp.error else {}),
+            **(
+                {"n_tokens": len(resp.token_ids)}
+                if resp.token_ids else {}
+            ),
         )
+        _observe_cost(resp)
         self._r.delete(self._lease_key(resp.id))
         self._r.delete(self._hlease_key(resp.id))
         key = f"{self._prefix}:{resp.id}"

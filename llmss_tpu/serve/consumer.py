@@ -36,6 +36,7 @@ from llmss_tpu.serve.protocol import (
     GenerateResponse,
     prefix_hash,
 )
+from llmss_tpu.utils import metrics as metrics_mod
 from llmss_tpu.utils import trace
 
 logger = logging.getLogger("llmss_tpu.serve")
@@ -160,6 +161,12 @@ class Worker:
             # the producer can stitch fleet-wide timelines (GET /trace).
             **(
                 {"trace": trace.recorder().export(max_events=256)}
+                if trace.enabled() else {}
+            ),
+            # Windowed SLO series ride the same heartbeat; the cached
+            # export keeps repeat snapshots within a heartbeat cheap.
+            **(
+                {"series": metrics_mod.series().export(cache_s=1.0)}
                 if trace.enabled() else {}
             ),
         }
@@ -495,6 +502,11 @@ class ContinuousWorker:
             # Flight-recorder snapshot (see Worker.load_snapshot).
             **(
                 {"trace": trace.recorder().export(max_events=256)}
+                if trace.enabled() else {}
+            ),
+            # Windowed SLO series (see Worker.load_snapshot).
+            **(
+                {"series": metrics_mod.series().export(cache_s=1.0)}
                 if trace.enabled() else {}
             ),
         })

@@ -98,10 +98,14 @@ def fleet_status(
         routable = code == 200 and info.get("state", STATE_READY) == STATE_READY
         ready += int(routable)
         workers[wid] = {
-            # The flight-recorder snapshot rides the heartbeat for
-            # GET /trace stitching — hundreds of events would drown the
-            # operator-facing fleet view, so it stays off /fleet.
-            **{k: v for k, v in info.items() if k != "trace"},
+            # The flight-recorder snapshot and windowed-series blobs ride
+            # the heartbeat for GET /trace and /fleet/timeseries —
+            # hundreds of events/slots would drown the operator-facing
+            # fleet view, so they stay off /fleet.
+            **{
+                k: v for k, v in info.items()
+                if k not in ("trace", "series")
+            },
             "role": info.get("role", "unified"),
             "health": body.get("status"),
             "routable": routable,

@@ -62,6 +62,7 @@ import numpy as np
 from llmss_tpu.serve.protocol import (
     STATE_READY, GenerateRequest, GenerateResponse,
 )
+from llmss_tpu.utils import metrics as metrics_mod
 from llmss_tpu.utils import trace
 
 #: Wire-format magic + version. Bump on any layout change — decoders
@@ -278,6 +279,14 @@ class _RoleWorkerBase:
             # per-request publishes re-attach the cached blob so the
             # request hot path never pays the O(events) export.
             **({"trace": self._trace_export(now)} if trace.enabled() else {}),
+            # Windowed SLO series ride the same cadence; the registry's
+            # own export cache bounds the cost of forced publishes.
+            **(
+                {"series": metrics_mod.series().export(
+                    cache_s=self.snapshot_interval_s,
+                )}
+                if trace.enabled() else {}
+            ),
         })
 
     def _trace_export(self, now: float) -> dict:

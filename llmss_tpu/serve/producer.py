@@ -23,6 +23,7 @@ from llmss_tpu.serve.protocol import (
     STATE_READY,
     GenerateRequest,
 )
+from llmss_tpu.utils import metrics as metrics_mod
 from llmss_tpu.utils import trace
 from llmss_tpu.utils.metrics import profile_trace, render_prometheus
 
@@ -47,6 +48,32 @@ def collect_trace_exports(broker: Broker) -> list[dict]:
         if isinstance(blob, dict):
             exports.append(blob)
     return exports
+
+
+def collect_series_exports(broker: Broker) -> tuple[list[dict], dict]:
+    """Every windowed-series export visible from this producer: the local
+    registry plus the per-worker blobs riding the registry heartbeats
+    (``load_snapshot`` embeds ``series``). Returns ``(exports, sources)``
+    — each export tagged with a ``source`` label, plus per-source role
+    metadata for ``/fleet/timeseries``. In-process fleets surface the
+    same registry through several heartbeats;
+    ``metrics.dedup_series_exports`` (applied by every consumer of these
+    exports) keeps one blob per process."""
+    exports: list[dict] = []
+    sources: dict[str, dict] = {}
+    if trace.enabled():
+        local = dict(metrics_mod.series().export())
+        local["source"] = "producer"
+        exports.append(local)
+        sources["producer"] = {"role": "producer"}
+    for wid, info in sorted(broker.read_workers().items()):
+        blob = info.get("series")
+        if isinstance(blob, dict):
+            tagged = dict(blob)
+            tagged["source"] = wid
+            exports.append(tagged)
+            sources[wid] = {"role": info.get("role", "unified")}
+    return exports, sources
 
 
 def trace_timeline_response(
@@ -193,8 +220,12 @@ class ProducerServer:
 
     def __init__(self, broker: Broker, host: str = "0.0.0.0",
                  port: int = 8000, timeout_s: float = 300.0,
-                 max_queue_depth: int = 1024, router=None):
+                 max_queue_depth: int = 1024, router=None,
+                 slo_objectives=None):
         self.broker = broker
+        # SLO objectives served by GET /slo (attainment + burn rates over
+        # the windowed fleet series); None = metrics.DEFAULT_SLO_OBJECTIVES.
+        self.slo_objectives = slo_objectives
         # Optional serve.fleet.Router: when set, /generate places each
         # request on a replica's routed queue (policy-driven) instead of
         # the shared queue; without one, behavior is exactly the
@@ -236,13 +267,25 @@ class ProducerServer:
                 if path == "/health":
                     code, body = outer.health()
                     self._reply(code, body)
+                elif path == "/fleet/timeseries":
+                    self._reply(200, outer.timeseries())
                 elif path == "/fleet":
                     self._reply(200, outer.fleet())
+                elif path == "/slo":
+                    self._reply(200, outer.slo())
                 elif path == "/metrics":
                     payload = outer.metrics_payload()
                     if q.get("format", [""])[0] == "prometheus":
+                        exports, _src = collect_series_exports(
+                            outer.broker,
+                        )
                         self._reply_text(
-                            200, render_prometheus(payload),
+                            200, render_prometheus(
+                                payload,
+                                series=metrics_mod.cumulative_summary(
+                                    exports,
+                                ),
+                            ),
                             _PROM_CONTENT_TYPE,
                         )
                     else:
@@ -262,7 +305,12 @@ class ProducerServer:
                     except ValueError:
                         self._reply(400, {"error": "n must be an integer"})
                         return
-                    self._reply(200, {"slowest": outer.trace_slowest(n)})
+                    phase = q.get("phase", [None])[0] or None
+                    self._reply(
+                        200, {"slowest": outer.trace_slowest(n, phase)},
+                    )
+                elif path == "/trace/export_workload":
+                    self._reply(200, outer.workload())
                 elif path.startswith("/trace/"):
                     rid = path[len("/trace/"):]
                     code, body = trace_timeline_response(
@@ -500,10 +548,34 @@ class ProducerServer:
             payload["fleet"] = fleet
         return payload
 
-    def trace_slowest(self, n: int = 10) -> list[dict]:
+    def trace_slowest(
+        self, n: int = 10, phase: str | None = None,
+    ) -> list[dict]:
         """GET /trace/slowest: the n slowest requests visible fleet-wide,
-        each with its dominant phase (where the time actually went)."""
-        return trace.slowest(collect_trace_exports(self.broker), n=n)
+        each with its dominant phase (where the time actually went).
+        ``?phase=`` reranks by time spent in that phase alone."""
+        return trace.slowest(
+            collect_trace_exports(self.broker), n=n, phase=phase,
+        )
+
+    def slo(self) -> dict:
+        """GET /slo: per-objective attainment and multi-window burn rates
+        from the windowed fleet-aggregated series — the signal the
+        autoscaler and priority scheduler consume."""
+        exports, _src = collect_series_exports(self.broker)
+        return metrics_mod.evaluate_slos(exports, self.slo_objectives)
+
+    def timeseries(self) -> dict:
+        """GET /fleet/timeseries: per-worker/per-series windowed points on
+        a wall-aligned time base."""
+        exports, sources = collect_series_exports(self.broker)
+        return metrics_mod.timeseries_payload(exports, sources)
+
+    def workload(self) -> dict:
+        """GET /trace/export_workload: the retained timelines as a
+        replayable arrival process (tools/trace_workload.py replays it;
+        the fleet simulator consumes it)."""
+        return trace.export_workload(collect_trace_exports(self.broker))
 
     def fleet_metrics(self) -> dict | None:
         """Fleet block for GET /metrics: per-worker load/queue-depth
@@ -581,7 +653,8 @@ class ProducerServer:
 
 
 def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
-                       max_queue_depth: int = 1024, router=None):
+                       max_queue_depth: int = 1024, router=None,
+                       slo_objectives=None):
     """FastAPI variant of the producer (optional dependency, gated).
 
     Full API parity with ``ProducerServer``: POST /generate (JSON or SSE
@@ -590,7 +663,9 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
     policy routing when a ``router`` is given), POST /cancel,
     POST /profile, GET /metrics (?format=prometheus), GET /health
     (fleet-aggregate when a worker registry is populated), GET /fleet,
-    GET /dlq, GET /trace/{req_id} (?format=chrome), GET /trace/slowest."""
+    GET /fleet/timeseries, GET /slo, GET /dlq, GET /trace/{req_id}
+    (?format=chrome), GET /trace/slowest (?phase=), and
+    GET /trace/export_workload."""
     import time as _time
 
     from fastapi import FastAPI, HTTPException
@@ -747,14 +822,35 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
                 fleet["router"] = router.stats()
             payload["fleet"] = fleet
         if format == "prometheus":
+            exports, _src = collect_series_exports(broker)
             return PlainTextResponse(
-                render_prometheus(payload), media_type=_PROM_CONTENT_TYPE,
+                render_prometheus(
+                    payload,
+                    series=metrics_mod.cumulative_summary(exports),
+                ),
+                media_type=_PROM_CONTENT_TYPE,
             )
         return payload
 
+    @app.get("/slo")
+    def slo():
+        exports, _src = collect_series_exports(broker)
+        return metrics_mod.evaluate_slos(exports, slo_objectives)
+
+    @app.get("/fleet/timeseries")
+    def fleet_timeseries():
+        exports, sources = collect_series_exports(broker)
+        return metrics_mod.timeseries_payload(exports, sources)
+
     @app.get("/trace/slowest")
-    def trace_slowest(n: int = 10):
-        return {"slowest": trace.slowest(collect_trace_exports(broker), n=n)}
+    def trace_slowest(n: int = 10, phase: str | None = None):
+        return {"slowest": trace.slowest(
+            collect_trace_exports(broker), n=n, phase=phase or None,
+        )}
+
+    @app.get("/trace/export_workload")
+    def trace_export_workload():
+        return trace.export_workload(collect_trace_exports(broker))
 
     @app.get("/trace/{req_id}")
     def trace_req(req_id: str, format: str | None = None):
@@ -823,7 +919,17 @@ def main(argv=None):
                              "per-worker routed queues via the worker "
                              "registry (workers must run with --worker_id); "
                              "omit for the shared queue")
+    parser.add_argument("--slo_config", default=None,
+                        help="path to a JSON list of SLO objectives "
+                             "served by GET /slo (see "
+                             "metrics.DEFAULT_SLO_OBJECTIVES for the "
+                             "schema); omit for the defaults")
     args = parser.parse_args(argv)
+
+    slo_objectives = None
+    if args.slo_config:
+        with open(args.slo_config) as f:
+            slo_objectives = json.load(f)
 
     from llmss_tpu.serve.broker import RedisBroker
 
@@ -836,7 +942,8 @@ def main(argv=None):
     server = ProducerServer(broker, args.host, args.port,
                             timeout_s=args.timeout_s,
                             max_queue_depth=args.max_queue_depth,
-                            router=router)
+                            router=router,
+                            slo_objectives=slo_objectives)
     print(f"producer listening on {args.host}:{server.port}")
     server.serve_forever()
 

@@ -166,6 +166,15 @@ class FlightRecorder:
             e = self._reqs.get(req_id)
             return [dict(ev) for ev in e["events"]] if e else []
 
+    def _events_view(self, req_id: str) -> list[dict]:
+        """Shallow read-only snapshot (the list is copied, the event dicts
+        are not — they are append-only and never mutated after insert).
+        Hot-path twin of :meth:`events_for` for the respond-time cost
+        derivation; callers must not modify the dicts."""
+        with self._lock:
+            e = self._reqs.get(req_id)
+            return list(e["events"]) if e else []
+
     def req_ids(self) -> list[str]:
         with self._lock:
             return list(self._reqs)
@@ -335,9 +344,14 @@ def timeline(exports, req_id: str) -> dict | None:
     }
 
 
-def slowest(exports, n: int = 10) -> list[dict]:
+def slowest(exports, n: int = 10, phase: str | None = None) -> list[dict]:
     """Tail-latency attribution: the ``n`` slowest retained requests by
-    first-to-last event span, each with its dominant phase."""
+    first-to-last event span, each with its dominant phase.
+
+    ``phase`` reranks by time attributed to that phase alone (e.g.
+    ``phase="kv_export"`` answers "which requests were slowest in
+    handoff"), dropping requests that never entered it.
+    """
     by_req: dict[str, list[dict]] = {}
     for e in stitch(exports):
         by_req.setdefault(e["req_id"], []).append(e)
@@ -354,8 +368,216 @@ def slowest(exports, n: int = 10) -> list[dict]:
             "phases": {k: round(v, 6) for k, v in sorted(phases.items())},
             "n_events": len(evs),
         })
-    rows.sort(key=lambda r: r["total_s"], reverse=True)
+    if phase is not None:
+        rows = [r for r in rows if r["phases"].get(phase)]
+        for r in rows:
+            r["rank_phase"] = phase
+            r["phase_s"] = r["phases"][phase]
+        rows.sort(key=lambda r: r["phase_s"], reverse=True)
+    else:
+        rows.sort(key=lambda r: r["total_s"], reverse=True)
     return rows[:max(0, int(n))]
+
+
+# -- per-request cost attribution -------------------------------------------
+
+
+def _ts(e: dict) -> float:
+    # Stitched events carry fleet-aligned ``ts_wall``; raw local events
+    # only ``t`` (monotonic). Either is internally consistent for deltas.
+    return e.get("ts_wall", e["t"])
+
+
+def _span_sum(evs, name: str) -> float:
+    return sum(e.get("dur") or 0.0 for e in evs if e["name"] == name)
+
+
+def _round6(v):
+    return round(v, 6) if v is not None else None
+
+
+def request_cost(events, assume_sorted: bool = False) -> dict | None:
+    """Derive the compact ``RequestCost`` record from one request's events
+    (stitched fleet-wide or raw from one recorder).
+
+    Returns None unless the events contain a terminal ``respond`` — cost
+    records exist only for settled requests, which is what makes the
+    attribution exactly-once: a chaos-killed replica's partial timeline
+    yields nothing; the surviving path that answers the request yields
+    the one record, with every delivery attempt's prefill/handoff time
+    already merged into the same req_id timeline.
+    """
+    # Single pass over time-sorted events (a hot-path constraint: brokers
+    # derive this at every respond, so no per-phase rescans). One recorder's
+    # events are appended in monotonic order (``assume_sorted``); only
+    # stitched multi-process timelines pay for the sort.
+    evs = list(events)
+    if not assume_sorted:
+        evs.sort(key=_ts)
+    term_t = None
+    t_attrs: dict = {}
+    enq_t = lease_t = first_tok_t = None
+    prefill = decode = wire = kv_span = kv_block_s = 0.0
+    pending_push: list[float] = []  # handoff_push awaiting its next lease
+    handoff_bytes = 0
+    fin_tokens = 0
+    attempts = 0
+    reprefills = 0
+    trace_id = None
+    for e in evs:
+        name = e["name"]
+        t = e.get("ts_wall", e["t"])
+        a = e.get("attrs")
+        if trace_id is None and e.get("trace_id"):
+            trace_id = e["trace_id"]
+        if a and "attempt" in a and a["attempt"] > attempts:
+            attempts = a["attempt"]
+        if name == "enqueue":
+            if enq_t is None:
+                enq_t = t
+        elif name == "lease":
+            if lease_t is None:
+                lease_t = t
+        elif name in ("admit", "adopt"):
+            if first_tok_t is None:
+                first_tok_t = t
+        elif name == "prefill":
+            prefill += e.get("dur") or 0.0
+        elif name == "decode":
+            decode += e.get("dur") or 0.0
+        elif name == "handoff_push":
+            pending_push.append(t)
+            if a:
+                handoff_bytes += a.get("bytes", 0)
+        elif name == "handoff_lease":
+            # Wire time: each push pairs with the FIRST lease at/after it
+            # (sorted order ⇒ every pending push precedes this lease).
+            for pt in pending_push:
+                wire += t - pt
+            pending_push.clear()
+        elif name in ("kv_export", "kv_adopt"):
+            kv_span += e.get("dur") or 0.0
+        elif name == "finish":
+            if a:
+                fin_tokens += a.get("tokens", 0)
+                kv_block_s += a.get("kv_block_s", 0.0)
+        elif name == "reprefill":
+            reprefills += 1
+        elif name in TERMINAL_EVENTS:
+            term_t = t
+            t_attrs = a or {}
+    if term_t is None:
+        return None
+
+    queue_wait = None
+    if enq_t is not None and lease_t is not None and lease_t >= enq_t:
+        queue_wait = lease_t - enq_t
+    # TTFT: arrival -> the scheduler's first-token resolution (``admit``
+    # carries dur_s = submit->first-token; ``adopt`` marks a handoff row's
+    # first decode-side token).
+    ttft = None
+    if enq_t is not None and first_tok_t is not None and (
+        first_tok_t >= enq_t
+    ):
+        ttft = first_tok_t - enq_t
+    tokens = t_attrs.get("n_tokens")
+    if tokens is None:
+        tokens = fin_tokens or None
+    err = t_attrs.get("error")
+    _r = _round6
+    return {
+        "req_id": evs[0]["req_id"],
+        "trace_id": trace_id,
+        "ok": bool(t_attrs.get("ok", err is None)),
+        "error": err,
+        "total_s": _r(term_t - _ts(evs[0])),
+        "queue_wait_s": _r(queue_wait),
+        "ttft_s": _r(ttft),
+        "prefill_s": _r(prefill) or None,
+        "handoff_s": _r(wire + kv_span) or None,
+        "handoff_bytes": handoff_bytes or None,
+        "decode_s": _r(decode) or None,
+        "tokens": tokens,
+        "kv_block_s": _r(kv_block_s) or None,
+        "attempts": attempts or 1,
+        "reprefills": reprefills,
+        "n_events": len(evs),
+    }
+
+
+def derive_costs(exports) -> list[dict]:
+    """One RequestCost per settled request across the stitched exports
+    (requests without a terminal event are still in flight — or died with
+    their replica — and are skipped)."""
+    by_req: dict[str, list[dict]] = {}
+    for e in stitch(exports):
+        by_req.setdefault(e["req_id"], []).append(e)
+    out = []
+    for evs in by_req.values():
+        cost = request_cost(evs)
+        if cost is not None:
+            out.append(cost)
+    return out
+
+
+def local_cost(req_id: str, error: str | None = None) -> dict | None:
+    """RequestCost from THIS process's recorder (the terminal-time hook:
+    brokers call it right after recording ``respond``). ``error``
+    overrides the ok/error fields for responses settled exceptionally."""
+    evs = _RECORDER._events_view(req_id)
+    if not evs:
+        return None
+    cost = request_cost(evs, assume_sorted=True)
+    if cost is None:
+        return None
+    if error is not None:
+        cost["ok"] = False
+        cost["error"] = error
+    return cost
+
+
+# -- trace-to-workload export -----------------------------------------------
+
+WORKLOAD_FORMAT = "llmss-workload/1"
+
+
+def export_workload(exports) -> dict:
+    """Convert stitched timelines into a replayable arrival process — the
+    input the deterministic fleet simulator consumes (capture -> replay).
+
+    Each retained request becomes one row keyed by its FIRST ``enqueue``
+    (re-routes and re-prefills are delivery mechanics, not arrivals);
+    ``arrival_s`` offsets are relative to the earliest arrival so replay
+    is start-time independent. ``priority`` is reserved for the SLO-tiered
+    scheduler.
+    """
+    by_req: dict[str, list[dict]] = {}
+    for e in stitch(exports):
+        by_req.setdefault(e["req_id"], []).append(e)
+    rows = []
+    for rid, evs in by_req.items():
+        enq = next((e for e in evs if e["name"] == "enqueue"), None)
+        if enq is None:
+            continue
+        a = enq.get("attrs") or {}
+        rows.append({
+            "req_id": rid,
+            "_arrival_ts": _ts(enq),
+            "prompt_len": a.get("plen"),
+            "max_new_tokens": a.get("max_new"),
+            "prefix_hash": a.get("prefix"),
+            "priority": None,
+        })
+    rows.sort(key=lambda r: r["_arrival_ts"])
+    t0 = rows[0]["_arrival_ts"] if rows else 0.0
+    for r in rows:
+        r["arrival_s"] = round(r.pop("_arrival_ts") - t0, 6)
+    return {
+        "format": WORKLOAD_FORMAT,
+        "n_requests": len(rows),
+        "span_s": rows[-1]["arrival_s"] if rows else 0.0,
+        "requests": rows,
+    }
 
 
 def to_chrome_trace(exports, req_id: str | None = None) -> dict:

@@ -90,6 +90,12 @@ def fire_and_forget(req):
     trace.recorder().start_span(req.id, "decode")
     run_decode(req)
 """,
+    "unbounded-metric-label": """
+from llmss_tpu.utils import metrics
+
+def handle(req_id):
+    metrics.series().counter(f"requests_{req_id}").add()
+""",
     "unguarded-write": """
 import threading
 
@@ -308,6 +314,43 @@ def _decode_impl(params, tok, cache):
     return tok, cache
 
 decode = jax.jit(_decode_impl, donate_argnums=(2,))
+""")
+    assert (code, findings) == (0, [])
+
+
+def test_metric_label_taint_through_str_and_concat(tmp_path):
+    # str() wraps and +-concat are the usual laundering paths; the walk
+    # must see through both, and `.labels(...)` / `labels=` count too.
+    code, findings = lint(tmp_path, """
+from llmss_tpu.utils import metrics
+
+def a(trace_id):
+    metrics.series().histogram("lat_" + str(trace_id)).observe(1.0)
+
+def b(request_id):
+    metrics.series().counter("reqs").labels(request_id).add()
+
+def c(req):
+    make_gauge("queue_depth", labels={"req": req.req_id})
+""")
+    assert code == 1
+    hits = [f for f in findings if f.rule == "unbounded-metric-label"]
+    assert len(hits) == 3
+    assert {f.line for f in hits} == {5, 8, 11}
+
+
+def test_metric_label_bounded_names_and_trace_record_not_flagged(tmp_path):
+    # Bounded vocabularies are the point of the rule staying quiet; the
+    # per-request id's rightful home — trace.record(req_id, ...) — must
+    # never be flagged (traces are per-request by design).
+    code, findings = lint(tmp_path, """
+from llmss_tpu.utils import metrics, trace
+
+def observe(req_id, phase, dur_s):
+    trace.record(req_id, "respond", ok=True)
+    metrics.series().counter("requests_total").add()
+    metrics.series().histogram(f"{phase}_s").observe(dur_s)
+    metrics.series().counter("reqs").labels(phase).add()
 """)
     assert (code, findings) == (0, [])
 

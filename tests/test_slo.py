@@ -1,0 +1,658 @@
+"""SLO plane (``utils/metrics.py`` windowed layer + cost attribution).
+
+The tentpole claims pinned here:
+
+- windowed rings rotate on monotonic epochs (slot reuse resets the
+  window, never the cumulative total) and merge across process exports
+  against each export's OWN mono anchor — no cross-host clock compare;
+- burn rates come out of windowed fleet-aggregated series and match the
+  hand-computed ``(1 - attainment) / (1 - target)`` on a pinned export;
+- a chaos-killed decode replica's requests are cost-attributed exactly
+  once, on BOTH broker shapes — the settling ``push_response`` is the
+  single ingestion point;
+- the trace-to-workload export replays through a stub arrival-process
+  consumer and re-serves through a fresh broker pair;
+- the producer surfaces it all (``/slo``, ``/fleet/timeseries``,
+  ``/trace/slowest?phase=``, ``/trace/export_workload``, Prometheus
+  ``_bucket`` families) and ``LLMSS_TRACE=0`` records nothing;
+- plane ingestion is host-side only: zero steady-state recompiles.
+"""
+
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+import httpx
+import pytest
+
+from llmss_tpu.analysis import cli as lint_cli
+from llmss_tpu.serve.broker import InProcBroker, RedisBroker
+from llmss_tpu.serve.chaos import (
+    ChaosWorkerHost,
+    FakeRedis,
+    HardKill,
+    ScriptedEngine,
+)
+from llmss_tpu.serve.handoff import DecodeWorker, PrefillWorker
+from llmss_tpu.serve.producer import ProducerServer
+from llmss_tpu.serve.protocol import GenerateRequest
+from llmss_tpu.utils import metrics, trace
+from llmss_tpu.utils.metrics import (
+    DEFAULT_BOUNDS_S,
+    SeriesRegistry,
+    WindowedCounter,
+    WindowedHistogram,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+BROKER_KINDS = ("inproc", "fakeredis")
+
+
+def make_brokers(kind, **kw):
+    if kind == "inproc":
+        b = InProcBroker(**kw)
+        return b, (lambda wid: b)
+    server = FakeRedis()
+
+    def mk(wid):
+        return RedisBroker(client=server, worker_id=wid, **kw)
+
+    return mk("producer"), mk
+
+
+@pytest.fixture(autouse=True)
+def clean_plane():
+    """Each test starts from an empty recorder AND series registry."""
+    trace.set_enabled(True)
+    trace.recorder().clear()
+    metrics.series().clear()
+    yield
+    trace.set_enabled(True)
+    trace.recorder().clear()
+    metrics.series().clear()
+
+
+def _run_to_completion(b, workers, reqs, timeout_s=20.0):
+    got = {}
+    deadline = time.monotonic() + timeout_s
+    while len(got) < len(reqs) and time.monotonic() < deadline:
+        for w in workers:
+            w.run_once()
+        for r in reqs:
+            if r.id not in got:
+                resp = b.wait_response(r.id, timeout=0.01)
+                if resp is not None:
+                    got[r.id] = resp
+    return got
+
+
+# -- windowed ring mechanics --------------------------------------------------
+
+
+def test_counter_ring_rotation_resets_window_not_total():
+    c = WindowedCounter("c", n_buckets=4, bucket_s=1.0)
+    c.add(1.0, t=0.5)   # epoch 0 -> slot 0
+    c.add(2.0, t=1.5)   # epoch 1 -> slot 1
+    assert c.window_sum(4.0, now=2.0) == 3.0
+    # Epoch 4 wraps onto slot 0: the stale epoch-0 value is lazily reset
+    # out of the window, but the cumulative total keeps it.
+    c.add(5.0, t=4.5)
+    assert c.total == 8.0
+    assert c.window_sum(10.0, now=5.0) == 7.0
+    # A narrow trailing window sees only the newest slot.
+    assert c.window_sum(1.0, now=4.9) == 5.0
+    ex = c.export()
+    assert ex["kind"] == "counter" and ex["total"] == 8.0
+    assert ex["slots"] == [[1, 2.0], [4, 5.0]]
+
+
+def test_histogram_ring_windows_and_cumulative_totals():
+    h = WindowedHistogram("h", bounds=(0.1, 1.0), n_buckets=4, bucket_s=1.0)
+    h.observe(0.05, t=0.5)   # le 0.1
+    h.observe(0.5, t=1.5)    # le 1.0
+    h.observe(5.0, t=1.6)    # +inf tail
+    w = h.window_counts(2.0, now=2.0)
+    assert w["count"] == 3 and w["counts"] == [1, 1, 1]
+    assert abs(w["sum"] - 5.55) < 1e-9
+    # Only the epoch-1 slot is live in a 1 s trailing window at t=2.5.
+    w1 = h.window_counts(1.0, now=2.5)
+    assert w1["count"] == 2 and w1["counts"] == [0, 1, 1]
+    # Ring wrap (epoch 4 -> slot 0) resets the slot, not the cumulatives.
+    h.observe(0.05, t=4.5)
+    assert h.total_count == 4 and h.total_counts == [2, 1, 1]
+    assert h.window_counts(1.0, now=5.0)["counts"] == [1, 0, 0]
+    ex = h.export()
+    assert ex["total"]["count"] == 4
+    assert [s[0] for s in ex["slots"]] == [1, 4]
+
+
+def test_bound_edges_use_le_semantics():
+    h = WindowedHistogram("h", bounds=(0.1, 1.0), n_buckets=4, bucket_s=1.0)
+    h.observe(0.1, t=0.5)   # exactly on a bound -> that bucket (le)
+    h.observe(1.0, t=0.5)
+    assert h.total_counts == [1, 1, 0]
+
+
+def test_merged_window_respects_each_exports_own_anchor():
+    """Two processes with wildly different monotonic epochs (uptime 1000 s
+    vs 50 s): each export's slots are judged live against its OWN anchor,
+    so the merge needs no cross-host clock agreement."""
+    ex_a = {
+        "proc": "pA", "mono_anchor": 1000.0, "wall_anchor": 5000.0,
+        "series": {"c": {
+            "kind": "counter", "bucket_s": 10.0, "total": 9.0,
+            # epoch 99 ends at 1000 (live @5m); epoch 60 ends at 610
+            # (dead @5m, live @1h).
+            "slots": [[60, 4.0], [99, 3.0]],
+        }},
+    }
+    ex_b = {
+        "proc": "pB", "mono_anchor": 50.0, "wall_anchor": 5000.2,
+        "series": {"c": {
+            "kind": "counter", "bucket_s": 10.0, "total": 2.0,
+            "slots": [[4, 2.0]],  # ends at 50 == pB's anchor: live
+        }},
+    }
+    assert metrics.merged_window([ex_a, ex_b], "c", 300.0)["value"] == 5.0
+    assert metrics.merged_window([ex_a, ex_b], "c", 3600.0)["value"] == 9.0
+    assert metrics.merged_window([ex_a, ex_b], "nope", 300.0) is None
+    # The same process arriving via several heartbeats counts once.
+    assert len(metrics.dedup_series_exports([ex_a, ex_a, ex_b])) == 2
+
+
+def test_registry_export_is_anchored_and_cached():
+    reg = SeriesRegistry(proc="t")
+    reg.counter("c").add(1.0)
+    ex = reg.export(cache_s=60.0)
+    assert "mono_anchor" in ex and "wall_anchor" in ex and ex["proc"] == "t"
+    # Within cache_s the SAME blob comes back — the heartbeat path never
+    # re-snapshots per worker tick.
+    assert reg.export(cache_s=60.0) is ex
+    assert reg.export(cache_s=0.0) is not ex
+
+
+def test_metrics_module_is_wall_clock_clean():
+    """The windowed layer must live on monotonic time: graftlint's
+    wall-clock-timer rule stays silent on it (wall_anchor is the one
+    exempted wall read per export)."""
+    _code, findings = lint_cli.run(
+        [str(REPO_ROOT / "llmss_tpu" / "utils" / "metrics.py"),
+         str(REPO_ROOT / "llmss_tpu" / "utils" / "trace.py")],
+        baseline_path=None,
+    )
+    assert not [f for f in findings if f.rule == "wall-clock-timer"]
+
+
+# -- burn-rate math vs hand-computed ------------------------------------------
+
+
+def _pinned_slo_exports():
+    """One synthetic export, anchored at mono 1000.0 with all slots live:
+    10 ttft observations (5 at <=0.5 s, 5 at <=1.0 s), 10 requests, 1
+    error. Hand-computed vs target 0.95 / 0.999:
+
+    - ttft attainment 0.5 -> burn (1-0.5)/0.05 = 10.0, p95 = 1.0 s
+    - error attainment 0.9 -> burn 0.1/0.001 = 100.0
+    """
+    counts = [0] * (len(DEFAULT_BOUNDS_S) + 1)
+    counts[DEFAULT_BOUNDS_S.index(0.5)] = 5
+    counts[DEFAULT_BOUNDS_S.index(1.0)] = 5
+    return [{
+        "proc": "pA", "mono_anchor": 1000.0, "wall_anchor": 5000.0,
+        "series": {
+            "ttft_s": {
+                "kind": "histogram", "bucket_s": 10.0,
+                "bounds": list(DEFAULT_BOUNDS_S),
+                "total": {"count": 10, "sum": 6.0, "counts": counts},
+                "slots": [[99, 10, 6.0, counts]],
+            },
+            "requests_total": {
+                "kind": "counter", "bucket_s": 10.0, "total": 10.0,
+                "slots": [[99, 10.0]],
+            },
+            "requests_error": {
+                "kind": "counter", "bucket_s": 10.0, "total": 1.0,
+                "slots": [[99, 1.0]],
+            },
+        },
+    }]
+
+
+def test_burn_rates_match_hand_computed():
+    out = metrics.evaluate_slos(_pinned_slo_exports())
+    assert out["windows"] == {"5m": 300.0, "1h": 3600.0}
+    rows = {r["name"]: r for r in out["objectives"]}
+    assert set(rows) == {"ttft_p95_500ms", "e2e_p95_5s",
+                         "terminal_error_rate"}
+
+    ttft = rows["ttft_p95_500ms"]
+    for w in ("5m", "1h"):
+        cell = ttft["windows"][w]
+        assert cell["count"] == 10
+        assert cell["attainment"] == 0.5
+        assert cell["burn_rate"] == 10.0
+        assert cell["p95_ms"] == 1000.0
+    assert ttft["met"] is False
+
+    err = rows["terminal_error_rate"]
+    cell = err["windows"]["5m"]
+    assert cell["count"] == 10 and cell["bad"] == 1
+    assert cell["attainment"] == 0.9
+    assert cell["burn_rate"] == 100.0
+    assert err["met"] is False
+
+    # No e2e_s series in the exports: the objective reports empty windows
+    # rather than inventing attainment from nothing.
+    e2e = rows["e2e_p95_5s"]
+    assert e2e["windows"]["5m"]["attainment"] is None
+    assert e2e["met"] is None
+
+
+def test_clean_window_burns_nothing():
+    exports = _pinned_slo_exports()
+    exports[0]["series"]["requests_error"]["slots"] = []
+    rows = {
+        r["name"]: r for r in metrics.evaluate_slos(exports)["objectives"]
+    }
+    cell = rows["terminal_error_rate"]["windows"]["5m"]
+    assert cell["attainment"] == 1.0 and cell["burn_rate"] == 0.0
+    assert rows["terminal_error_rate"]["met"] is True
+
+
+def test_observe_request_cost_feeds_every_sink():
+    reg = SeriesRegistry(proc="t")
+    cost = {
+        "req_id": "r", "ok": True, "error": None, "total_s": 0.8,
+        "ttft_s": 0.2, "queue_wait_s": 0.05, "prefill_s": 0.1,
+        "decode_s": 0.4, "handoff_s": 0.01, "handoff_bytes": 4096,
+        "tokens": 32, "kv_block_s": 1.5, "attempts": 1, "reprefills": 0,
+    }
+    metrics.observe_request_cost(cost, registry=reg)
+    metrics.observe_request_cost({**cost, "ok": False, "error": "boom",
+                                  "reprefills": 2}, registry=reg)
+    assert reg.counter("requests_total").total == 2.0
+    assert reg.counter("requests_error").total == 1.0
+    assert reg.counter("tokens_out").total == 64.0
+    assert reg.counter("handoff_bytes").total == 8192.0
+    assert reg.counter("kv_block_seconds").total == 3.0
+    assert reg.counter("reprefills").total == 2.0
+    assert reg.histogram("e2e_s").total_count == 2
+    assert reg.histogram("ttft_s").total_count == 2
+    assert abs(reg.histogram("decode_s").total_sum - 0.8) < 1e-9
+    # A cost record missing optional phases (no handoff) skips those
+    # sinks instead of polluting them with zeros.
+    metrics.observe_request_cost(
+        {"req_id": "r2", "ok": True, "total_s": 0.1, "ttft_s": None,
+         "handoff_s": None, "tokens": None}, registry=reg,
+    )
+    assert reg.counter("requests_total").total == 3.0
+    assert reg.histogram("ttft_s").total_count == 2
+    assert reg.histogram("handoff_s").total_count == 2
+
+
+# -- exactly-once cost attribution under chaos --------------------------------
+
+
+class _KillOnAdopt(ScriptedEngine):
+    """First N adoptions die mid-adopt with the handoff lease open."""
+
+    def __init__(self, kills: int):
+        super().__init__()
+        self._kills_left = kills
+        self._klock = threading.Lock()
+
+    def adopt_generate(self, *a, **kw):
+        with self._klock:
+            if self._kills_left > 0:
+                self._kills_left -= 1
+                raise HardKill("chaos: decode replica died mid-adopt")
+        return super().adopt_generate(*a, **kw)
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_chaos_kill_attributes_cost_exactly_once(kind):
+    """Two decode replicas die mid-handoff; every request still settles
+    and produces exactly ONE cost record — requests_total equals the
+    request count, with the killed attempts' reprefills folded into the
+    surviving record rather than spawning extra ones."""
+    b, mk = make_brokers(kind, lease_s=0.25, max_delivery_attempts=6)
+    eng = _KillOnAdopt(2)
+    pre = ChaosWorkerHost(
+        lambda: PrefillWorker(
+            ScriptedEngine(), mk("p0"), worker_id="p0", poll_timeout_s=0.02,
+        ),
+        respawn_delay_s=0.02,
+    )
+    dec = ChaosWorkerHost(
+        lambda: DecodeWorker(
+            eng, mk("d0"), worker_id="d0", poll_timeout_s=0.02,
+        ),
+        respawn_delay_s=0.02,
+    )
+    reqs = [
+        GenerateRequest(
+            id=f"c{i}", token_ids=[i + 2, 9], max_new_tokens=4,
+            deadline_ts=time.time() + 30.0,
+        )
+        for i in range(4)
+    ]
+    pre.start()
+    dec.start()
+    try:
+        for r in reqs:
+            b.push_request(r)
+        for r in reqs:
+            resp = b.wait_response(r.id, timeout=20.0)
+            assert resp is not None, f"lost {r.id}"
+            assert resp.error is None, (r.id, resp.error)
+    finally:
+        pre.stop()
+        dec.stop()
+    assert pre.error is None and dec.error is None
+    assert dec.kills == 2
+
+    # Exactly-once: one ingestion per request, none for dead attempts.
+    reg = metrics.series()
+    assert reg.counter("requests_total").total == len(reqs)
+    assert reg.counter("requests_error").total == 0.0
+    assert reg.histogram("e2e_s").total_count == len(reqs)
+    assert reg.counter("reprefills").total == 2.0
+
+    costs = trace.derive_costs([trace.recorder().export()])
+    by_id = {c["req_id"]: c for c in costs}
+    assert set(by_id) == {r.id for r in reqs}  # one record per request
+    assert len(costs) == len(reqs)
+    assert all(c["ok"] for c in costs)
+    assert sum(c["reprefills"] for c in costs) == 2
+    for c in costs:
+        assert c["total_s"] >= 0.0 and c["tokens"]
+        if c["reprefills"]:
+            # The killed request's record carries its full delivery story.
+            assert c["attempts"] >= 2
+    # The windowed view agrees with the trace-derived one.
+    assert reg.counter("tokens_out").total == sum(c["tokens"] for c in costs)
+
+
+def test_error_response_attributed_as_error():
+    b, mk = make_brokers("inproc", lease_s=2.0)
+    from llmss_tpu.serve.protocol import GenerateResponse
+
+    trace.record("bad", "enqueue", trace_id="bad")
+    b.push_response(GenerateResponse(id="bad", token_ids=[], error="boom"))
+    reg = metrics.series()
+    assert reg.counter("requests_total").total == 1.0
+    assert reg.counter("requests_error").total == 1.0
+
+
+# -- trace-to-workload export and replay --------------------------------------
+
+
+def _tools():
+    sys.path.insert(0, str(REPO_ROOT / "tools"))
+    try:
+        import trace_workload
+    finally:
+        sys.path.pop(0)
+    return trace_workload
+
+
+def _serve(reqs, kind="inproc", **kw):
+    b, mk = make_brokers(kind, lease_s=5.0, **kw)
+    pre = PrefillWorker(ScriptedEngine(), mk("p0"), worker_id="p0")
+    dec = DecodeWorker(ScriptedEngine(), mk("d0"), worker_id="d0")
+    for r in reqs:
+        b.push_request(r)
+    got = _run_to_completion(b, [pre, dec], reqs)
+    assert len(got) == len(reqs)
+    return b
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_workload_export_roundtrip_through_stub_consumer(tmp_path, kind):
+    shared = [7] * 8
+    reqs = [
+        GenerateRequest(id="w0", token_ids=[1, 2, 3], max_new_tokens=4,
+                        prefix_token_ids=shared),
+        GenerateRequest(id="w1", token_ids=[4, 5], max_new_tokens=3,
+                        prefix_token_ids=shared),
+        GenerateRequest(id="w2", token_ids=[6, 7, 8, 9], max_new_tokens=2),
+    ]
+    _serve(reqs, kind)
+
+    wl = trace.export_workload([trace.recorder().export()])
+    assert wl["format"] == trace.WORKLOAD_FORMAT
+    assert wl["n_requests"] == 3
+    rows = {r["req_id"]: r for r in wl["requests"]}
+    assert rows["w0"]["prompt_len"] == 3 and rows["w0"]["max_new_tokens"] == 4
+    assert rows["w2"]["prompt_len"] == 4 and rows["w2"]["prefix_hash"] is None
+    # Prefix identity (not contents) is captured: the two sharers agree.
+    assert rows["w0"]["prefix_hash"] == rows["w1"]["prefix_hash"] is not None
+    arrivals = [r["arrival_s"] for r in wl["requests"]]
+    assert arrivals[0] == 0.0 and arrivals == sorted(arrivals)
+    assert wl["span_s"] == arrivals[-1]
+
+    # Replay through a stub arrival-process consumer.
+    tw = _tools()
+    got: list = []
+    assert tw.replay(wl, got.append) == 3
+    assert [r.id for r in got] == [r["req_id"] for r in wl["requests"]]
+    for r in got:
+        assert len(r.token_ids) == rows[r.id]["prompt_len"]
+        assert r.max_new_tokens == rows[r.id]["max_new_tokens"]
+    by_id = {r.id: r for r in got}
+    # Shared capture-time prefix -> identical synthesized replay prefix,
+    # so the prefix cache sees the production hit structure.
+    assert by_id["w0"].prefix_token_ids == by_id["w1"].prefix_token_ids
+    assert by_id["w0"].prefix_token_ids and by_id["w2"].prefix_token_ids is None
+
+    summary = tw.summarize(wl)
+    assert summary["n_requests"] == 3 and summary["distinct_prefixes"] == 1
+
+    # File round-trip + format guard.
+    p = tmp_path / "wl.json"
+    p.write_text(json.dumps(wl))
+    assert tw.load_workload(str(p)) == wl
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"format": "nope"}))
+    with pytest.raises(ValueError):
+        tw.load_workload(str(bad))
+    with pytest.raises(ValueError):
+        tw.replay({"format": "nope"}, got.append)
+
+
+def test_replayed_workload_reserves_end_to_end():
+    reqs = [
+        GenerateRequest(id=f"rr{i}", token_ids=[i + 1, 2, 3],
+                        max_new_tokens=3)
+        for i in range(3)
+    ]
+    _serve(reqs)
+    wl = trace.export_workload([trace.recorder().export()])
+    tw = _tools()
+
+    # The captured arrival process drives a FRESH broker pair.
+    trace.recorder().clear()
+    metrics.series().clear()
+    b, mk = make_brokers("inproc", lease_s=5.0)
+    pre = PrefillWorker(ScriptedEngine(), mk("p0"), worker_id="p0")
+    dec = DecodeWorker(ScriptedEngine(), mk("d0"), worker_id="d0")
+    replayed: list = []
+
+    def submit(req):
+        replayed.append(req)
+        b.push_request(req)
+
+    assert tw.replay(wl, submit) == 3
+    got = _run_to_completion(b, [pre, dec], replayed)
+    assert len(got) == 3
+    for req in replayed:
+        assert got[req.id].token_ids == ScriptedEngine.expected_tokens(
+            list(req.token_ids), req.max_new_tokens,
+        )
+    # The replay itself was cost-attributed like any other traffic.
+    assert metrics.series().counter("requests_total").total == 3.0
+
+
+def test_replay_paces_real_time_arrivals():
+    tw = _tools()
+    wl = {
+        "format": trace.WORKLOAD_FORMAT, "n_requests": 2, "span_s": 0.2,
+        "requests": [
+            {"req_id": "a", "arrival_s": 0.0, "prompt_len": 2,
+             "max_new_tokens": 1, "prefix_hash": None, "priority": None},
+            {"req_id": "b", "arrival_s": 0.2, "prompt_len": 2,
+             "max_new_tokens": 1, "prefix_hash": None, "priority": None},
+        ],
+    }
+    t0 = time.monotonic()
+    tw.replay(wl, lambda r: None, speed=2.0)  # 2x: ~0.1 s gap
+    elapsed = time.monotonic() - t0
+    assert 0.05 <= elapsed < 2.0
+
+
+# -- producer endpoints -------------------------------------------------------
+
+
+def test_producer_slo_plane_endpoints():
+    reqs = [
+        GenerateRequest(id=f"e{i}", token_ids=[i + 1, 4], max_new_tokens=3)
+        for i in range(3)
+    ]
+    b = _serve(reqs)
+    srv = ProducerServer(b, host="127.0.0.1", port=0, timeout_s=5.0)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+
+        slo = httpx.get(f"{base}/slo").json()
+        assert slo["windows"] == {"5m": 300.0, "1h": 3600.0}
+        rows = {r["name"]: r for r in slo["objectives"]}
+        err = rows["terminal_error_rate"]["windows"]["5m"]
+        # Computed from the windowed series the serve pass just fed.
+        assert err["count"] == 3 and err["bad"] == 0
+        assert err["attainment"] == 1.0 and err["burn_rate"] == 0.0
+        assert rows["e2e_p95_5s"]["windows"]["5m"]["count"] == 3
+
+        ts = httpx.get(f"{base}/fleet/timeseries").json()["series"]
+        assert "requests_total" in ts and "e2e_s" in ts
+        row = ts["requests_total"]
+        pts = row["sources"]["producer"]["points"]
+        assert pts and sum(p["v"] for p in pts) == 3.0
+        assert all("t" in p for p in pts)
+        assert ts["e2e_s"]["bounds"] == list(DEFAULT_BOUNDS_S)
+
+        sl = httpx.get(f"{base}/trace/slowest?n=5&phase=decode").json()
+        for r in sl["slowest"]:
+            assert r["rank_phase"] == "decode" and r["phase_s"] > 0.0
+        assert {r["req_id"] for r in sl["slowest"]} == {r.id for r in reqs}
+        assert httpx.get(
+            f"{base}/trace/slowest?phase=never_entered",
+        ).json()["slowest"] == []
+
+        wl = httpx.get(f"{base}/trace/export_workload").json()
+        assert wl["format"] == trace.WORKLOAD_FORMAT
+        assert wl["n_requests"] == 3
+
+        prom = httpx.get(f"{base}/metrics?format=prometheus")
+        assert prom.status_code == 200
+        assert 'llmss_e2e_s_bucket{le="' in prom.text
+        assert 'llmss_e2e_s_bucket{le="+Inf"} 3' in prom.text
+        assert "llmss_e2e_s_count 3" in prom.text
+        assert "# TYPE llmss_requests_total counter" in prom.text
+        # JSON stays the default and free of the windowed families.
+        r = httpx.get(f"{base}/metrics")
+        assert r.headers["content-type"].startswith("application/json")
+        assert "e2e_s" not in r.json()
+    finally:
+        srv.stop()
+
+
+# -- tracing off records nothing ----------------------------------------------
+
+
+def test_plane_disabled_records_nothing():
+    trace.set_enabled(False)
+    reqs = [GenerateRequest(id="off", token_ids=[3, 4], max_new_tokens=3)]
+    b, mk = make_brokers("inproc", lease_s=2.0)
+    pre = PrefillWorker(ScriptedEngine(), mk("p0"), worker_id="p0")
+    dec = DecodeWorker(ScriptedEngine(), mk("d0"), worker_id="d0")
+    for r in reqs:
+        b.push_request(r)
+    got = _run_to_completion(b, [pre, dec], reqs, timeout_s=10.0)
+    assert got and got["off"].token_ids
+    # No recorder entries, no series, no cost records.
+    assert trace.recorder().req_ids() == []
+    assert metrics.series().names() == []
+    assert trace.derive_costs([trace.recorder().export()]) == []
+    srv = ProducerServer(b, host="127.0.0.1", port=0, timeout_s=5.0)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        rows = httpx.get(f"{base}/slo").json()["objectives"]
+        assert all(
+            c["attainment"] is None
+            for r in rows for c in r["windows"].values()
+        )
+        assert httpx.get(f"{base}/trace/export_workload").json()[
+            "n_requests"] == 0
+    finally:
+        srv.stop()
+
+
+# -- plane ingestion adds zero steady-state recompiles ------------------------
+
+import jax  # noqa: E402
+
+from llmss_tpu.engine import DecodeEngine, GenerationParams  # noqa: E402
+from llmss_tpu.engine.scheduler import ContinuousBatcher  # noqa: E402
+from llmss_tpu.models.common import DecoderConfig  # noqa: E402
+from llmss_tpu.models.decoder import init_params  # noqa: E402
+from llmss_tpu.parallel import MeshPlan, make_mesh  # noqa: E402
+
+
+def test_slo_plane_adds_no_steady_state_recompiles(devices):
+    """Cost derivation, series ingestion, export, and SLO evaluation are
+    host-side only: running the whole plane against a warmed engine hits
+    the jit caches exactly as before — zero new compiles."""
+    from llmss_tpu.analysis import CompileGuard
+
+    cfg = DecoderConfig(
+        model_type="llama", vocab_size=64, hidden_size=32, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=8, intermediate_size=64,
+        max_position_embeddings=64, activation="silu", norm="rmsnorm",
+        norm_eps=1e-5, mlp="swiglu", positions="rotary", rope_style="half",
+        rotary_dim=8, attn_bias=False, mlp_bias=False,
+        tie_word_embeddings=False, dtype="float32",
+    )
+    mesh = make_mesh(MeshPlan(dp=2, tp=4))
+    params = init_params(cfg, mesh, jax.random.key(0))
+    engine = DecodeEngine(cfg, params, mesh, max_seq_len=64)
+    batcher = ContinuousBatcher(
+        engine, rows=2, chunk_steps=2, group_chunks=2,
+    )
+    batcher.prewarm()
+    gen = GenerationParams(max_new_tokens=4, is_greedy=True)
+
+    guard = CompileGuard.for_engine(engine)
+    assert guard._fns, "engine exposes no jitted callables to guard"
+    got = {}
+    with guard.steady_state():
+        for i, p in enumerate([[5, 9], [3, 14, 15]]):
+            batcher.submit(
+                p, gen, lambda t, i=i: got.__setitem__(i, t),
+                req_id=f"s{i}",
+            )
+        batcher.run_until_idle()
+        # The full plane, inside the guard: derive + ingest + evaluate.
+        for i in range(2):
+            trace.record(f"s{i}", "respond", ok=True)
+            cost = trace.local_cost(f"s{i}")
+            assert cost is not None
+            metrics.observe_request_cost(cost)
+        payload = metrics.evaluate_slos([metrics.series().export()])
+    assert len(got) == 2
+    assert metrics.series().counter("requests_total").total == 2.0
+    assert payload["objectives"]

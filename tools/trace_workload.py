@@ -1,0 +1,176 @@
+"""Trace-to-workload: capture a live fleet's arrival process and replay it.
+
+A stitched trace corpus already contains everything a load generator
+needs — when each request arrived, how long its prompt was, how many
+tokens it asked for, and which requests shared a cached prefix. The
+producer's ``GET /trace/export_workload`` distils that into a compact
+``llmss-workload/1`` JSON (see ``trace.export_workload``): arrival
+offsets from the first request, prompt/max_new lengths, prefix hashes,
+and a ``priority`` slot reserved for a future scheduler class.
+
+This tool does two jobs:
+
+* **CLI** — fetch the workload from a running producer (or read an
+  already-saved file) and write it out, so a production traffic shape
+  can be carried to a bench box as one small file::
+
+      python tools/trace_workload.py http://prod:8000/trace/export_workload \
+          --out workload.json
+      python tools/trace_workload.py workload.json --summary
+
+* **Library** — ``replay(workload, submit, speed=...)`` re-enacts the
+  arrival process against any submit callable (``Broker.push_request``,
+  a producer HTTP client, or a test stub). Token contents are
+  synthesized deterministically: the trace records *lengths and prefix
+  identity*, not token values (prompts never leave the fleet), so two
+  requests that shared a prefix hash at capture time share a
+  deterministically derived prefix at replay time — the prefix-affinity
+  router and scheduler prefix cache see the same shape the production
+  traffic had.
+
+``speed=0`` (the default) submits as fast as possible, preserving only
+the *order*; ``speed=1.0`` reproduces real-time inter-arrival gaps;
+``speed=2.0`` replays at double speed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from llmss_tpu.serve.protocol import GenerateRequest  # noqa: E402
+from llmss_tpu.utils import trace  # noqa: E402
+
+#: Synthesized shared-prefix length. The workload records prefix
+#: *identity* (a hash), not its length; any fixed length reproduces the
+#: cache-hit structure, which is what replay is after.
+PREFIX_LEN = 16
+VOCAB = 50257
+
+
+def load_workload(source: str) -> dict:
+    """Read a workload JSON from a file path or a producer URL."""
+    if source.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+
+        with urlopen(source, timeout=30) as r:
+            payload = json.load(r)
+    else:
+        with open(source) as f:
+            payload = json.load(f)
+    fmt = payload.get("format")
+    if fmt != trace.WORKLOAD_FORMAT:
+        raise ValueError(
+            f"{source}: format {fmt!r} is not {trace.WORKLOAD_FORMAT!r}"
+        )
+    return payload
+
+
+def _prefix_tokens(ph: str) -> list[int]:
+    """Deterministic token block for one captured prefix hash.
+
+    Seeded from the hash digits so distinct production prefixes stay
+    distinct at replay and every replayer derives the same tokens.
+    """
+    try:
+        seed = int(str(ph)[:8], 16)
+    except ValueError:
+        seed = sum(ord(c) for c in str(ph))
+    return [(seed + j * 31) % VOCAB for j in range(PREFIX_LEN)]
+
+
+def synthesize_request(
+    row: dict, index: int = 0, prefixes: dict | None = None
+) -> GenerateRequest:
+    """One replayable request from one workload row."""
+    plen = int(row.get("prompt_len") or 16)
+    req = GenerateRequest(
+        id=str(row.get("req_id") or f"wl-{index}"),
+        token_ids=[(index * 7 + j) % VOCAB for j in range(plen)],
+        max_new_tokens=int(row.get("max_new_tokens") or 20),
+    )
+    ph = row.get("prefix_hash")
+    if ph is not None:
+        if prefixes is None:
+            prefixes = {}
+        if ph not in prefixes:
+            prefixes[ph] = _prefix_tokens(ph)
+        req.prefix_token_ids = prefixes[ph]
+    return req
+
+
+def replay(workload: dict, submit, speed: float = 0.0) -> int:
+    """Re-enact the arrival process; returns the number submitted.
+
+    ``submit`` receives one ``GenerateRequest`` per captured row, in
+    arrival order. ``speed`` scales real time: 0 = no pacing (order
+    only), 1.0 = captured inter-arrival gaps, 2.0 = twice as fast.
+    """
+    if workload.get("format") != trace.WORKLOAD_FORMAT:
+        raise ValueError(f"not a {trace.WORKLOAD_FORMAT} payload")
+    rows = sorted(workload.get("requests", []), key=lambda r: r["arrival_s"])
+    prefixes: dict = {}
+    t0 = time.monotonic()
+    n = 0
+    for i, row in enumerate(rows):
+        if speed > 0:
+            lag = row["arrival_s"] / speed - (time.monotonic() - t0)
+            if lag > 0:
+                time.sleep(lag)
+        submit(synthesize_request(row, i, prefixes))
+        n += 1
+    return n
+
+
+def summarize(workload: dict) -> dict:
+    rows = workload.get("requests", [])
+    plens = [r.get("prompt_len") or 0 for r in rows]
+    news = [r.get("max_new_tokens") or 0 for r in rows]
+    span = workload.get("span_s") or 0.0
+    return {
+        "n_requests": len(rows),
+        "span_s": round(span, 3),
+        "arrival_rate_per_s": round(len(rows) / span, 2) if span else None,
+        "prompt_len_mean": round(sum(plens) / len(plens), 1) if plens else 0,
+        "max_new_mean": round(sum(news) / len(news), 1) if news else 0,
+        "distinct_prefixes": len(
+            {r["prefix_hash"] for r in rows if r.get("prefix_hash")}
+        ),
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="fetch / inspect a replayable trace workload",
+    )
+    parser.add_argument(
+        "source",
+        help="producer /trace/export_workload URL, or a saved workload file",
+    )
+    parser.add_argument(
+        "--out", default=None,
+        help="write the workload JSON here (default: stdout)",
+    )
+    parser.add_argument(
+        "--summary", action="store_true",
+        help="print a one-line shape summary instead of the payload",
+    )
+    args = parser.parse_args(argv)
+
+    wl = load_workload(args.source)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(wl, f, indent=2)
+        print(f"wrote {wl['n_requests']} request(s) to {args.out}")
+    if args.summary or not args.out:
+        print(json.dumps(summarize(wl) if args.summary else wl))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
