@@ -80,6 +80,14 @@ class _Row:
     # host hasn't yet fetched its prefill-sampled first token.
     awaiting_first: bool = True
     t_submit: float = 0.0
+    # Preemption rank (SLO_CLASS_RANK: 0 = interactive, highest). A
+    # pending request with a strictly LOWER rank may evict this row when
+    # admission is blocked; equal ranks never preempt (livelock).
+    priority: int = 1
+    # Tokens this row replays from a previous (preempted) run: ``out`` is
+    # preloaded with them and finish thresholds shift by this count, so
+    # the resumed stream continues exactly where the evicted one stopped.
+    replayed: int = 0
 
 
 @dataclasses.dataclass
@@ -210,6 +218,12 @@ class ContinuousBatcher:
         # ``blocks`` the export_blocks() host-array dict; set by the
         # serving layer before submitting.
         self.export_cb: Callable[..., None] | None = None
+        # Preemption hook: called as preempt_cb(req_id, tokens) when a
+        # running row is evicted for a higher-priority pending request
+        # (the serving layer stamps resume_tokens and refunds the request
+        # to the broker). None disables preemption entirely — the check
+        # never runs, keeping FIFO deployments at zero overhead.
+        self.preempt_cb: Callable[[str, list[int]], None] | None = None
         if self._paged:
             mb = engine.max_seq_len // engine.block_size
             n_blocks = engine.kv_blocks or rows * mb
@@ -713,13 +727,28 @@ class ContinuousBatcher:
         req_id: str = "",
         stream_cb: Callable[[list[int]], None] | None = None,
         prefix=None,  # engine.Prefix: token_ids must extend it
+        priority: int = 1,
+        replayed: int = 0,
     ) -> None:
         """Queue a request. ``prefix`` (from ``engine.build_prefix``) marks
         ``token_ids`` as extending a retained KV segment: admission seeds
         the row from the segment and prefills only the suffix — turn-2 of
         a session (or the Nth request sharing a system prompt) skips the
-        shared prefill entirely, with identical tokens."""
+        shared prefill entirely, with identical tokens.
+
+        ``priority`` is the SLO-class rank (0 = interactive, highest);
+        ``replayed`` resumes a preempted request: the LAST ``replayed``
+        entries of ``token_ids`` are its already-emitted tokens (prompt +
+        resume tokens prefill as one prompt — sampling is stateless per
+        (seed, position), so the continuation is identical to the
+        unpreempted run), preloaded into the row's output so the stream
+        picks up where it stopped and ``max_new_tokens`` counts only the
+        REMAINING tokens."""
         gen.validate()
+        if replayed and not 0 < replayed < len(token_ids):
+            raise ValueError(
+                f"replayed={replayed} must be in [0, len(token_ids))"
+            )
         if prefix is not None:
             # Same contract split_prefix enforces; checked at submit time
             # so the error surfaces on the caller, not the worker thread.
@@ -748,7 +777,7 @@ class ContinuousBatcher:
         with self._lock:
             self.pending.append(
                 (req_id, list(token_ids), gen, done_cb, stream_cb,
-                 time.perf_counter(), prefix)
+                 time.perf_counter(), prefix, priority, replayed)
             )
             depth = len(self.pending)
         if req_id:
@@ -832,9 +861,7 @@ class ContinuousBatcher:
             return None
         plen = head_prefix.length if head_prefix is not None else 0
         # With a prefix, only each request's suffix is padded/prefilled.
-        suffixes = [
-            ids[plen:] for _rid, ids, _g, _cb, _scb, _t, _p in taken
-        ]
+        suffixes = [item[1][plen:] for item in taken]
         S = _bucket(
             max(len(s) for s in suffixes), self.engine.max_seq_len,
         )
@@ -921,12 +948,18 @@ class ContinuousBatcher:
             pass
 
         entries = []
-        for i, (req_id, ids, gen, cb, scb, t_submit, _pfx) in enumerate(
-            taken
+        for i, (req_id, ids, gen, cb, scb, t_submit, _pfx, pri, rpl) in (
+            enumerate(taken)
         ):
             r = _Row(
-                req_id=req_id, gen=gen, out=[], done_cb=cb, stream_cb=scb,
-                awaiting_first=True, t_submit=t_submit,
+                req_id=req_id, gen=gen,
+                # Resumed rows preload the replayed tokens (the prompt's
+                # tail) so done_cb returns the full generation while
+                # ``emitted`` keeps the stream from re-sending them.
+                out=list(ids[len(ids) - rpl:]) if rpl else [],
+                done_cb=cb, stream_cb=scb, awaiting_first=True,
+                t_submit=t_submit, priority=pri, replayed=rpl,
+                emitted=rpl,
             )
             self.active[rows[i]] = r
             self._row_pos[rows[i]] = len(ids)
@@ -975,17 +1008,88 @@ class ContinuousBatcher:
                 jnp.asarray(starts), jnp.asarray(row_idx),
             )
         )
-        for i, (req_id, ids, gen, cb, scb, t_submit, _pfx) in enumerate(
-            taken
+        for i, (req_id, ids, gen, cb, scb, t_submit, _pfx, pri, rpl) in (
+            enumerate(taken)
         ):
             r = _Row(
-                req_id=req_id, gen=gen, out=[], done_cb=cb, stream_cb=scb,
-                awaiting_first=True, t_submit=t_submit,
+                req_id=req_id, gen=gen,
+                out=list(ids[len(ids) - rpl:]) if rpl else [],
+                done_cb=cb, stream_cb=scb, awaiting_first=True,
+                t_submit=t_submit, priority=pri, replayed=rpl,
+                emitted=rpl,
             )
             self.active[rows[i]] = r
             self._row_pos[rows[i]] = start
             self._inflight_prefill[rows[i]] = list(ids[start:])
             self._prefill_plen[rows[i]] = len(ids)
+
+    def _maybe_preempt(self) -> int:
+        """Evict the lowest-priority running row when the head pending
+        request strictly outranks it and admission is blocked on rows or
+        pool blocks. At most ONE eviction per step — the freed capacity
+        feeds this same step's ``_admit_dispatch``, and bounding the hook
+        keeps its host cost within the per-request overhead budget
+        (tools/bench_priority.py measures the no-op path).
+
+        The eviction mirrors ``_finish`` minus the terminal callback:
+        flush what already streamed, release the row's blocks (owned free,
+        COW prefix shares decref — exactly balancing the reserve's
+        increfs), and hand the emitted tokens to ``preempt_cb`` for the
+        broker refund. Tokens for this row still inside the in-flight
+        group are discarded unseen; sampling is stateless per (seed,
+        position), so the resume regenerates them identically."""
+        cb = self.preempt_cb
+        if cb is None or self.prefill_only:
+            return 0
+        with self._lock:
+            if not self.pending:
+                return 0
+            head = self.pending[0]
+            free_rows = len(self._free)
+        head_pri = head[7]
+        blocked = free_rows == 0
+        if not blocked and self._paged:
+            ids, gen = head[1], head[2]
+            need = -(
+                -(len(ids) + gen.max_new_tokens) // self.engine.block_size
+            )
+            blocked = need > self.allocator.free_blocks
+        if not blocked:
+            return 0
+        victim = None
+        for row, r in self.active.items():
+            # Only settled rows are evictable: a row awaiting its first
+            # token (admission in flight, or prompt still streaming
+            # through ragged chunks) has no resume point yet, and an
+            # anonymous row can't be refunded to a broker.
+            if r.awaiting_first or not r.req_id:
+                continue
+            if r.priority <= head_pri or row in self._inflight_prefill:
+                continue
+            if victim is None or (
+                (r.priority, -len(r.out))
+                > (victim[1].priority, -len(victim[1].out))
+            ):
+                # Lowest class first; ties evict the row with the FEWEST
+                # emitted tokens — the cheapest replay prefill.
+                victim = (row, r)
+        if victim is None:
+            return 0
+        row, r = victim
+        self._flush_stream(r)
+        self.active.pop(row, None)
+        self._row_pos.pop(row, None)
+        self._prefill_plen.pop(row, None)
+        self._paged_release_row(row)
+        with self._lock:
+            self._free.append(row)
+        self.engine.metrics.add_preempted(1)
+        trace.record(
+            r.req_id, "evict", tokens=len(r.out), priority=r.priority,
+            for_priority=head_pri,
+        )
+        cb(r.req_id, list(r.out))
+        return 1
 
     def _resolve_admission(self, adm: _InFlightAdmission | None) -> int:
         """Host bookkeeping for a dispatched admission (fetch its first
@@ -1009,9 +1113,13 @@ class ContinuousBatcher:
         # TTFT spans submit → resolve: queueing for a free row, the
         # admission prefill (or the chunked prompt streaming), AND the
         # decode work the admission deliberately overlapped — the time a
-        # client actually waited for its first token.
-        self.engine.metrics.ttft.record(now - r.t_submit)
-        self.engine.metrics.add_request(1)
+        # client actually waited for its first token. Resumed rows skip
+        # both stats: their client saw its first token before the
+        # preemption, and counting the re-admission would double-bill
+        # requests_served.
+        if not r.replayed:
+            self.engine.metrics.ttft.record(now - r.t_submit)
+            self.engine.metrics.add_request(1)
         if r.req_id:
             # "admit" (not "prefill"): its duration is submit→first
             # token — queue wait + prefill + overlapped chunk — while
@@ -1036,7 +1144,7 @@ class ContinuousBatcher:
             return
         r.out.append(first)
         self.engine.metrics.add_tokens(1)
-        if len(r.out) >= r.gen.max_new_tokens:
+        if len(r.out) >= r.gen.max_new_tokens + r.replayed:
             self._finish(row, r)
         else:
             # First token goes out now, not a full chunk later —
@@ -1270,8 +1378,8 @@ class ContinuousBatcher:
             dropped = [p for p in self.pending if p[0] in ids]
             self.pending = deque(p for p in self.pending if p[0] not in ids)
         n = len(dropped)
-        for _rid, _ids, _gen, cb, _scb, _t, _pfx in dropped:
-            cb([], True)
+        for item in dropped:
+            item[3]([], True)
         for row, r in list(self.active.items()):
             if r.req_id in ids:
                 self._finish(row, r, cancelled=True)
@@ -1465,7 +1573,7 @@ class ContinuousBatcher:
                         break
                     r.out.append(t)
                     n += 1
-                    if len(r.out) >= r.gen.max_new_tokens:
+                    if len(r.out) >= r.gen.max_new_tokens + r.replayed:
                         finished = True
                         break
                 if finished:
@@ -1637,6 +1745,10 @@ class ContinuousBatcher:
         if prev is not None:
             n = self._process_group(prev)  # frees finished rows
         n += self._resolve_admission(self._pending_adm)
+        # Preemption sits between resolve and admit: an evicted row's slot
+        # and blocks feed THIS step's admission, so a blocked interactive
+        # request is running one group after its eviction decision.
+        self._maybe_preempt()
         # Admission takes the rows processing just freed; its device work
         # overlaps the in-flight group and lands before the next one.
         self._pending_adm = self._admit_dispatch()

@@ -35,12 +35,17 @@ client* — the response channel is keyed by request id and consumed once.
 from __future__ import annotations
 
 import abc
+import collections
 import queue
 import threading
 import time
 
 from llmss_tpu.serve.protocol import (
-    GenerateRequest, GenerateResponse, prefix_hash,
+    SLO_CLASS_STANDARD,
+    SLO_CLASSES,
+    GenerateRequest,
+    GenerateResponse,
+    prefix_hash,
 )
 from llmss_tpu.utils import metrics as metrics_mod
 from llmss_tpu.utils import trace
@@ -57,7 +62,16 @@ def _enqueue_attrs(req: GenerateRequest) -> dict:
     a["max_new"] = req.max_new_tokens
     if req.prefix_token_ids:
         a["prefix"] = prefix_hash(req.prefix_token_ids)
+    a["slo_class"] = req.slo_class
     return a
+
+
+def _req_class(req: GenerateRequest) -> str:
+    """The queue class for a request — unknown values (a newer client
+    speaking to an older fleet, or vice versa) degrade to standard
+    instead of creating an unbounded key/label set."""
+    cls = req.slo_class
+    return cls if cls in SLO_CLASSES else SLO_CLASS_STANDARD
 
 
 def _observe_cost(resp: GenerateResponse) -> None:
@@ -124,10 +138,31 @@ class Broker(abc.ABC):
         Returns the number of requests requeued."""
         return 0
 
+    def preempt_requests(self, reqs) -> int:
+        """Return preempted requests to their class queues with the same
+        refund semantics as ``release_requests``: the delivery attempt is
+        NOT consumed — a request evicted N times for higher-priority work
+        never inches toward the DLQ (preemption is the scheduler's
+        choice, not the request's fault). Unlike ``release_requests``
+        this takes request OBJECTS: the worker stamps ``resume_tokens``
+        and ``preemptions`` onto the request before requeueing, so the
+        resuming worker (possibly a different one) replays the emitted
+        tokens as chunked prefill. Requeued at the head of the request's
+        class queue — a preempted request is the oldest work in its
+        class. Unknown ids (lease already reaped) are ignored. Returns
+        the number requeued."""
+        return 0
+
     def queue_depth(self) -> int:
         """Requests waiting in the queue (not counting leased in-flight
         ones) — the producer's admission-control signal."""
         return 0
+
+    def queue_depths_by_class(self) -> dict:
+        """``{slo_class: depth}`` over shared + routed queues (closed
+        keyspace — one entry per ``SLO_CLASSES`` member). Empty for
+        brokers without class-aware queues."""
+        return {}
 
     def dlq_depth(self) -> int:
         return 0
@@ -362,7 +397,14 @@ class InProcBroker(Broker):
         self.response_ttl_s = (
             response_ttl_s if response_ttl_s is not None else self.CANCEL_TTL_S
         )
-        self._requests: queue.Queue[GenerateRequest] = queue.Queue()
+        # Class-tiered shared queue: one FIFO per SLO class, drained in
+        # strict class-priority order (interactive before standard before
+        # batch) under one condition so a blocking pop wakes on any
+        # class's enqueue.
+        self._queues: dict[str, collections.deque] = {  # guarded_by: self._req_cond
+            c: collections.deque() for c in SLO_CLASSES
+        }
+        self._req_cond = threading.Condition()
         self._responses: dict[str, GenerateResponse] = {}  # guarded_by: self._cond
         self._response_expiry: dict[str, float] = {}  # guarded_by: self._cond
         self._cond = threading.Condition()
@@ -384,6 +426,7 @@ class InProcBroker(Broker):
             "redelivered": 0, "dead_lettered": 0, "deadline_expired": 0,
             "failover_rerouted": 0,
             "handoffs": 0, "handoff_bytes": 0, "reprefills": 0,
+            "preempted": 0,
         }
         # KV handoff channel (disaggregated prefill/decode): shared +
         # per-decode-worker routed record queues, and handoff leases with
@@ -392,13 +435,43 @@ class InProcBroker(Broker):
         self._handoff_routed: dict[str, queue.Queue] = {}  # guarded_by: self._route_lock
         # rid -> (expiry, record, worker_id-or-None)
         self._handoff_leases: dict[str, tuple[float, object, str | None]] = {}  # guarded_by: self._lease_lock
-        # Fleet state: per-worker routed queues + TTL'd registry.
-        self._routed: dict[str, queue.Queue] = {}  # guarded_by: self._route_lock
+        # Fleet state: per-worker routed queues (class-tiered like the
+        # shared queue, so routing preserves priority ordering) + TTL'd
+        # registry.
+        self._routed: dict[str, dict[str, collections.deque]] = {}  # guarded_by: self._route_lock
         self._route_lock = threading.Lock()
         self._workers: dict[str, dict] = {}  # guarded_by: self._worker_lock
         # worker_id -> monotonic registry-entry expiry
         self._worker_expiry: dict[str, float] = {}  # guarded_by: self._worker_lock
         self._worker_lock = threading.Lock()
+
+    # -- class-tiered queue plumbing -----------------------------------------
+
+    def _enqueue(self, req: GenerateRequest, *, head: bool = False) -> None:
+        """Single choke point for every path that puts a request on the
+        shared queue (fresh push, redelivery, release refund, preemption
+        refund, handoff re-prefill): the request lands on its CLASS
+        queue, so requeues preserve priority ordering. ``head=True``
+        mirrors Redis's RPUSH-to-head service order for requeued (oldest)
+        work."""
+        with self._req_cond:
+            q = self._queues[_req_class(req)]
+            (q.appendleft if head else q.append)(req)
+            self._req_cond.notify_all()
+
+    def _dequeue(self, timeout: float = 0.0) -> GenerateRequest | None:
+        """Next request in strict class-priority order; blocks up to
+        ``timeout`` for ANY class to become non-empty."""
+        deadline = time.monotonic() + timeout
+        with self._req_cond:
+            while True:
+                for cls in SLO_CLASSES:
+                    if self._queues[cls]:
+                        return self._queues[cls].popleft()
+                remaining = deadline - time.monotonic()
+                if not timeout or remaining <= 0:
+                    return None
+                self._req_cond.wait(remaining)
 
     # -- fleet registry ------------------------------------------------------
 
@@ -439,15 +512,27 @@ class InProcBroker(Broker):
             **_enqueue_attrs(req),
         )
         with self._route_lock:
-            q = self._routed.setdefault(worker_id, queue.Queue())
-        q.put(req)
+            by_cls = self._routed.setdefault(worker_id, {})
+            by_cls.setdefault(_req_class(req), collections.deque()).append(req)
+
+    def _pop_routed(self, worker_id: str) -> GenerateRequest | None:
+        """Next routed request for one worker, in class-priority order."""
+        with self._route_lock:
+            by_cls = self._routed.get(worker_id)
+            if by_cls:
+                for cls in SLO_CLASSES:
+                    q = by_cls.get(cls)
+                    if q:
+                        return q.popleft()
+        return None
 
     def routed_depths(self) -> dict:
         with self._route_lock:
-            return {
-                wid: q.qsize() for wid, q in self._routed.items()
-                if q.qsize() > 0
+            out = {
+                wid: sum(len(q) for q in by_cls.values())
+                for wid, by_cls in self._routed.items()
             }
+        return {wid: d for wid, d in out.items() if d > 0}
 
     def lease_holders(self) -> dict:
         holders: dict[str, int] = {}
@@ -460,15 +545,14 @@ class InProcBroker(Broker):
     def failover_worker(self, worker_id: str) -> list[GenerateRequest]:
         out: list[GenerateRequest] = []
         # Routed-but-undelivered: never leased, so no delivery attempt is
-        # consumed — they simply move to a survivor.
+        # consumed — they simply move to a survivor (class ordering is
+        # preserved: the drain walks classes in priority order and the
+        # re-route lands each on the survivor's class queue).
         with self._route_lock:
-            q = self._routed.pop(worker_id, None)
-        if q is not None:
-            while True:
-                try:
-                    out.append(q.get_nowait())
-                except queue.Empty:
-                    break
+            by_cls = self._routed.pop(worker_id, None)
+        if by_cls:
+            for cls in SLO_CLASSES:
+                out.extend(by_cls.get(cls) or ())
         # Leased in-flight: force-expire through the standard disposition
         # so deadline-shed / dead-letter semantics match a natural expiry.
         with self._lease_lock:
@@ -622,7 +706,7 @@ class InProcBroker(Broker):
                 req.id, "reprefill", trace_id=req.trace_id,
                 attempt=req.trace_attempt,
             )
-            self._requests.put(req)
+            self._enqueue(req, head=True)
 
     def fail_handoff(self, record, error: str | None = None) -> None:
         self.ack_handoff(record.req.id)
@@ -739,7 +823,7 @@ class InProcBroker(Broker):
             req.id, "enqueue", trace_id=req.trace_id, queue="shared",
             **_enqueue_attrs(req),
         )
-        self._requests.put(req)
+        self._enqueue(req)
 
     def pop_request(
         self, timeout: float = 0.0, worker_id: str | None = None,
@@ -750,19 +834,10 @@ class InProcBroker(Broker):
             # Routed work first: requests a router pinned to THIS worker
             # (e.g. prefix affinity) must not rot behind shared-queue
             # traffic any worker could take.
-            with self._route_lock:
-                q = self._routed.get(worker_id)
-            if q is not None:
-                try:
-                    req = q.get_nowait()
-                except queue.Empty:
-                    req = None
+            req = self._pop_routed(worker_id)
         if req is None:
-            try:
-                req = self._requests.get(timeout=timeout) if timeout else (
-                    self._requests.get_nowait()
-                )
-            except queue.Empty:
+            req = self._dequeue(timeout)
+            if req is None:
                 return None
         req.delivery_attempts += 1
         with self._lease_lock:
@@ -824,7 +899,7 @@ class InProcBroker(Broker):
                 trace.record(
                     req.id, "redeliver", attempt=req.delivery_attempts,
                 )
-                self._requests.put(req)
+                self._enqueue(req, head=True)
         # Expired handoff leases: the decode replica that adopted the
         # blocks is presumed dead — standard handoff disposition
         # (re-prefill / dead-letter / deadline-shed).
@@ -852,7 +927,31 @@ class InProcBroker(Broker):
             req = held[1]
             req.delivery_attempts = max(0, req.delivery_attempts - 1)
             trace.record(rid, "release")
-            self._requests.put(req)
+            self._enqueue(req, head=True)
+            n += 1
+        return n
+
+    def preempt_requests(self, reqs) -> int:
+        n = 0
+        for req in reqs:
+            with self._lease_lock:
+                held = self._leases.pop(req.id, None)
+            if held is None:
+                continue  # lease already reaped — the reaper's requeue wins
+            # Refund the delivery attempt (release_requests semantics):
+            # being evicted for higher-priority work must never count
+            # toward the DLQ. The CALLER's request object is requeued —
+            # it carries the worker-stamped resume_tokens/preemptions the
+            # stale leased copy does not.
+            req.delivery_attempts = max(0, req.delivery_attempts - 1)
+            with self._lease_lock:
+                self._delivery_counts["preempted"] += 1
+            trace.record(
+                req.id, "preempt", trace_id=req.trace_id,
+                slo_class=req.slo_class, preemptions=req.preemptions,
+                n_resume=len(req.resume_tokens or ()),
+            )
+            self._enqueue(req, head=True)
             n += 1
         return n
 
@@ -860,9 +959,23 @@ class InProcBroker(Broker):
         # Backlog = shared queue + every routed queue: admission control
         # must see routed work too (with no routed queues this is exactly
         # the pre-fleet value).
+        with self._req_cond:
+            shared = sum(len(q) for q in self._queues.values())
         with self._route_lock:
-            routed = sum(q.qsize() for q in self._routed.values())
-        return self._requests.qsize() + routed
+            routed = sum(
+                len(q) for by_cls in self._routed.values()
+                for q in by_cls.values()
+            )
+        return shared + routed
+
+    def queue_depths_by_class(self) -> dict:
+        with self._req_cond:
+            out = {c: len(self._queues[c]) for c in SLO_CLASSES}
+        with self._route_lock:
+            for by_cls in self._routed.values():
+                for cls, q in by_cls.items():
+                    out[cls] = out.get(cls, 0) + len(q)
+        return out
 
     def dlq_depth(self) -> int:
         with self._lease_lock:
@@ -995,6 +1108,22 @@ class RedisBroker(Broker):
         # scheme as request leases.
         self._handoff_key = f"{request_queue}:h"
         self._hlease_prefix = f"{request_queue}:hlease"
+        # Class-tiered queues: standard stays on the legacy bare list
+        # (wire-compatible with pre-class producers/consumers — untagged
+        # traffic IS standard), interactive/batch ride {pqueue}:cls:{c}.
+        # The ":cls:" segment cannot collide with any other key family
+        # (lease/worker/w/h/hlease/stats/dlq all differ at that segment).
+        self._cls_prefix = f"{request_queue}:cls"
+
+    def _class_key(self, cls: str) -> str:
+        if cls == SLO_CLASS_STANDARD:
+            return self._rq
+        return f"{self._cls_prefix}:{cls}"
+
+    def _routed_class_key(self, worker_id: str, cls: str) -> str:
+        if cls == SLO_CLASS_STANDARD:
+            return self._routed_key(worker_id)
+        return f"{self._routed_key(worker_id)}:cls:{cls}"
 
     # -- fleet registry ------------------------------------------------------
     # Worker ids must not contain ":" — they are embedded as key segments
@@ -1055,7 +1184,9 @@ class RedisBroker(Broker):
             req.id, "enqueue", trace_id=req.trace_id, queue=worker_id,
             **_enqueue_attrs(req),
         )
-        self._r.lpush(self._routed_key(worker_id), req.to_json())
+        self._r.lpush(
+            self._routed_class_key(worker_id, _req_class(req)), req.to_json(),
+        )
 
     def routed_depths(self) -> dict:
         out: dict[str, int] = {}
@@ -1064,7 +1195,11 @@ class RedisBroker(Broker):
             k = key.decode() if isinstance(key, bytes) else str(key)
             depth = int(self._r.llen(k))
             if depth:
-                out[k[skip:]] = depth
+                # Routed class queues are {pqueue}:w:{wid}:cls:{c} — fold
+                # them into the worker's total (worker ids cannot contain
+                # ":", so the split is unambiguous).
+                wid = k[skip:].split(":cls:", 1)[0]
+                out[wid] = out.get(wid, 0) + depth
         return out
 
     def lease_holders(self) -> dict:
@@ -1080,11 +1215,14 @@ class RedisBroker(Broker):
         import json
 
         out: list[GenerateRequest] = []
-        while True:  # routed-but-undelivered: no attempt consumed
-            payload = self._r.rpop(self._routed_key(worker_id))
-            if not payload:
-                break
-            out.append(GenerateRequest.from_json(payload))
+        # Routed-but-undelivered: no attempt consumed; drained in class
+        # order so the re-route preserves priority.
+        for cls in SLO_CLASSES:
+            while True:
+                payload = self._r.rpop(self._routed_class_key(worker_id, cls))
+                if not payload:
+                    break
+                out.append(GenerateRequest.from_json(payload))
         # Leased in-flight: claim-by-delete (reaper-safe), standard
         # disposition — requeue-able requests return to the caller for
         # re-routing instead of landing back on the shared queue.
@@ -1240,7 +1378,7 @@ class RedisBroker(Broker):
                 req.id, "reprefill", trace_id=req.trace_id,
                 attempt=req.trace_attempt,
             )
-            self._r.rpush(self._rq, req.to_json())
+            self._r.rpush(self._class_key(_req_class(req)), req.to_json())
 
     def fail_handoff(self, record, error: str | None = None) -> None:
         self.ack_handoff(record.req.id)
@@ -1393,8 +1531,10 @@ class RedisBroker(Broker):
                     req.id, "redeliver", attempt=req.delivery_attempts,
                 )
                 # RPUSH: the pop side RPOPs, so a redelivered (oldest)
-                # request goes to the head of the service order.
-                self._r.rpush(self._rq, req.to_json())
+                # request goes to the head of its class's service order.
+                self._r.rpush(
+                    self._class_key(_req_class(req)), req.to_json(),
+                )
             n += 1
         # Expired handoff leases: same claim-by-delete scheme, handoff
         # disposition (re-prefill instead of redeliver).
@@ -1428,16 +1568,60 @@ class RedisBroker(Broker):
             req.delivery_attempts = max(0, req.delivery_attempts - 1)
             trace.record(rid, "release")
             # RPUSH like the reaper: released (oldest) work goes back to
-            # the head of the service order.
-            self._r.rpush(self._rq, req.to_json())
+            # the head of its class's service order.
+            self._r.rpush(self._class_key(_req_class(req)), req.to_json())
+            n += 1
+        return n
+
+    def preempt_requests(self, reqs) -> int:
+        n = 0
+        for req in reqs:
+            key = self._lease_key(req.id)
+            if not self._r.delete(key):
+                continue  # lease already reaped — the reaper's requeue wins
+            # Refund the delivery attempt (release_requests semantics);
+            # the CALLER's object is requeued because it carries the
+            # worker-stamped resume_tokens/preemptions.
+            req.delivery_attempts = max(0, req.delivery_attempts - 1)
+            self._r.incr(f"{self._stats_prefix}:preempted")
+            trace.record(
+                req.id, "preempt", trace_id=req.trace_id,
+                slo_class=req.slo_class, preemptions=req.preemptions,
+                n_resume=len(req.resume_tokens or ()),
+            )
+            # RPUSH-to-head of its class queue: a preempted request is
+            # the oldest work in its class and resumes first.
+            self._r.rpush(self._class_key(_req_class(req)), req.to_json())
             n += 1
         return n
 
     def queue_depth(self) -> int:
-        # Shared queue + every routed queue (admission control must see
-        # routed backlog too); no routed queues → exactly the old value.
+        # Shared class queues + every routed queue (admission control
+        # must see routed backlog too); no routed queues and no tagged
+        # traffic → exactly the old value.
         routed = sum(self.routed_depths().values())
-        return int(self._r.llen(self._rq)) + routed
+        shared = sum(
+            int(self._r.llen(self._class_key(c))) for c in SLO_CLASSES
+        )
+        return shared + routed
+
+    def queue_depths_by_class(self) -> dict:
+        out = {
+            c: int(self._r.llen(self._class_key(c))) for c in SLO_CLASSES
+        }
+        skip = len(self._routed_prefix) + 1
+        for key in list(self._r.scan_iter(match=f"{self._routed_prefix}:*")):
+            k = key.decode() if isinstance(key, bytes) else str(key)
+            depth = int(self._r.llen(k))
+            if not depth:
+                continue
+            tail = k[skip:]
+            cls = (
+                tail.split(":cls:", 1)[1] if ":cls:" in tail
+                else SLO_CLASS_STANDARD
+            )
+            out[cls] = out.get(cls, 0) + depth
+        return out
 
     def dlq_depth(self) -> int:
         return int(self._r.llen(self._dlq_key))
@@ -1455,6 +1639,7 @@ class RedisBroker(Broker):
             "redelivered", "dead_lettered", "deadline_expired",
             "failover_rerouted",
             "handoffs", "handoff_bytes", "reprefills",
+            "preempted",
         )
         vals = self._r.mget([f"{self._stats_prefix}:{k}" for k in names])
         inflight = sum(
@@ -1516,7 +1701,22 @@ class RedisBroker(Broker):
             req.id, "enqueue", trace_id=req.trace_id, queue="shared",
             **_enqueue_attrs(req),
         )
-        self._r.lpush(self._rq, req.to_json())
+        self._r.lpush(self._class_key(_req_class(req)), req.to_json())
+
+    def _rpop_by_class(self, worker_id: str | None) -> bytes | str | None:
+        """One non-blocking drain pass in strict class-priority order:
+        this worker's routed class queues first (router pinned them
+        here), then the shared class queues."""
+        if worker_id is not None:
+            for cls in SLO_CLASSES:
+                payload = self._r.rpop(self._routed_class_key(worker_id, cls))
+                if payload:
+                    return payload
+        for cls in SLO_CLASSES:
+            payload = self._r.rpop(self._class_key(cls))
+            if payload:
+                return payload
+        return None
 
     def pop_request(
         self, timeout: float = 0.0, worker_id: str | None = None,
@@ -1524,22 +1724,24 @@ class RedisBroker(Broker):
         # Lazy reaper: any live worker popping work also recovers expired
         # leases (including a dead worker's) — no dedicated reaper process.
         self.reap_expired()
-        payload = None
-        if worker_id is not None:
-            if worker_id != self._worker_id:
-                # A consumer's fleet id IS its lease identity: adopt it so
-                # acks (push_response deletes this worker's lease key) and
-                # failover attribution line up with the routed queue.
-                self._worker_id = worker_id
-            # Routed work first (router pinned it here — e.g. prefix
-            # affinity); the shared queue only when the routed one is dry.
-            payload = self._r.rpop(self._routed_key(worker_id))
-        if not payload:
-            if timeout:
-                item = self._r.brpop(self._rq, timeout=timeout)
-                payload = item[1] if item else None
-            else:
-                payload = self._r.rpop(self._rq)
+        if worker_id is not None and worker_id != self._worker_id:
+            # A consumer's fleet id IS its lease identity: adopt it so
+            # acks (push_response deletes this worker's lease key) and
+            # failover attribution line up with the routed queue.
+            self._worker_id = worker_id
+        payload = self._rpop_by_class(worker_id)
+        if not payload and timeout:
+            # Class-tiered blocking pop: BRPOP over one key can't observe
+            # three class lists with a priority order, so poll all of
+            # them in order until the deadline. The poll quantum bounds
+            # added latency at ~10 ms — well under any SLO target.
+            deadline = time.monotonic() + timeout
+            while not payload:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                time.sleep(min(0.01, remaining))
+                payload = self._rpop_by_class(worker_id)
         if not payload:
             return None
         req = GenerateRequest.from_json(payload)

@@ -30,6 +30,7 @@ from llmss_tpu.serve.handoff import (
     pick_decode_worker,
 )
 from llmss_tpu.serve.protocol import (
+    SLO_CLASS_RANK,
     STATE_DRAINING,
     STATE_READY,
     GenerateRequest,
@@ -266,6 +267,16 @@ class Worker:
                 req.validate()
                 ids = self._encode(req)
                 gp = self._gen_params(req)
+                if req.resume_tokens:
+                    # Resume after a preemption elsewhere in the fleet:
+                    # prompt + already-emitted tokens prefill as ONE
+                    # prompt and only the remainder decodes — sampling is
+                    # stateless per (seed, position), so the continuation
+                    # matches the unpreempted run exactly.
+                    ids = ids + list(req.resume_tokens)
+                    gp.max_new_tokens = (
+                        req.max_new_tokens - len(req.resume_tokens)
+                    )
                 # Same ring-capacity rule as ContinuousBatcher.submit.
                 self.engine.check_capacity(len(ids), gp.max_new_tokens)
                 prompts.append(ids)
@@ -348,6 +359,10 @@ class Worker:
                 worker=self.worker_id, batch=n_live,
             )
         for row, (req, toks) in enumerate(zip(ok, outs)):
+            if req.resume_tokens:
+                # The replayed tokens belong to the answer: the client
+                # sees one seamless stream across the preemption.
+                toks = list(req.resume_tokens) + toks
             if row in poisoned_rows:
                 # Per-row poison containment: this row's logits went
                 # NaN/inf mid-decode. Only this row errors — batch-mates
@@ -450,6 +465,16 @@ class ContinuousWorker:
         self._handoff_reqs: dict[str, GenerateRequest] = {}
         if role == "prefill":
             self.batcher.export_cb = self._on_export
+        # Every request currently inside the batcher, keyed by id: the
+        # preemption hook stamps resume_tokens/preemptions onto the
+        # ORIGINAL request object before refunding it to the broker.
+        self._reqs: dict[str, GenerateRequest] = {}
+        if role == "unified":
+            # Preemption only makes sense where this worker both admits
+            # from the request queue and decodes: a prefill replica's rows
+            # live for one prefill, and a decode replica's requests arrive
+            # as handoff records the request queue never redelivers.
+            self.batcher.preempt_cb = self._on_preempt
         # Decode role: popped-but-not-yet-adopted records (all rows busy).
         self._adopt_backlog: "deque" = deque()
         self.poll_timeout_s = poll_timeout_s
@@ -579,6 +604,16 @@ class ContinuousWorker:
                 def stream_cb(new_toks, req=req):
                     self.broker.push_stream(req.id, new_toks)
 
+            resume = list(req.resume_tokens or ())
+            if resume:
+                # Resume after preemption: prompt + already-emitted tokens
+                # admit as one (chunked-prefill) prompt; the batcher
+                # preloads the replayed tail into the row's output and
+                # decodes only the remainder — sampling is stateless per
+                # (seed, position), so greedy streams match the
+                # unpreempted run token for token.
+                ids = ids + resume
+                gen.max_new_tokens = req.max_new_tokens - len(resume)
             try:
                 prefix = (
                     self._get_prefix(req.prefix_token_ids)
@@ -589,12 +624,16 @@ class ContinuousWorker:
                     # can resolve (and its done_cb clean this up) inside
                     # the submit -> next step() window.
                     self._handoff_reqs[req.id] = req
+                self._reqs[req.id] = req
                 self.batcher.submit(
                     ids, gen, cb, req_id=req.id, stream_cb=stream_cb,
                     prefix=prefix,
+                    priority=SLO_CLASS_RANK.get(req.slo_class, 1),
+                    replayed=len(resume),
                 )
             except ValueError as e:  # e.g. prompt + max_new exceeds the ring
                 self._handoff_reqs.pop(req.id, None)
+                self._reqs.pop(req.id, None)
                 self.broker.push_response(
                     GenerateResponse(id=req.id, error=str(e))
                 )
@@ -609,6 +648,7 @@ class ContinuousWorker:
 
         def cb(toks, cancelled=False, error=None):
             self._handoff_reqs.pop(req.id, None)
+            self._reqs.pop(req.id, None)
             if error is not None:
                 # Row-level failure (e.g. poison containment): the
                 # batcher finished this row with an error; batch-mates
@@ -639,6 +679,21 @@ class ContinuousWorker:
             )
 
         return cb
+
+    # -- preemption ---------------------------------------------------------
+
+    def _on_preempt(self, rid: str, toks: list[int]) -> None:
+        """Batcher eviction hook: stamp the emitted tokens onto the
+        ORIGINAL request as its resume point and refund it to the broker
+        (``preempt_requests`` — head-of-class-queue requeue, delivery
+        attempt NOT consumed). The next worker to lease it replays the
+        tokens as chunked prefill and continues the identical stream."""
+        req = self._reqs.pop(rid, None)
+        if req is None:
+            return  # cancelled/finished concurrently — the row's gone
+        req.resume_tokens = list(toks) if toks else None
+        req.preemptions += 1
+        self.broker.preempt_requests([req])
 
     # -- KV handoff: prefill side -------------------------------------------
 
@@ -777,6 +832,8 @@ class ContinuousWorker:
         — no error, no redelivery count against the request. (Half 2, the
         active rows, gets ``abort_inflight``.)"""
         ids = self.batcher.drop_pending()
+        for rid in ids:
+            self._reqs.pop(rid, None)
         if ids:
             self.broker.release_requests(ids)
         return len(ids)
@@ -834,6 +891,7 @@ class ContinuousWorker:
             )
         ids = self.batcher.drain_all()
         for rid in ids:
+            self._reqs.pop(rid, None)
             self.broker.push_response(
                 GenerateResponse(id=rid, error=f"worker restarted: {reason}")
             )

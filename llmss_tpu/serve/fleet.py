@@ -50,6 +50,9 @@ from llmss_tpu.serve.broker import Broker
 from llmss_tpu.serve.chaos import ChaosWorkerHost
 from llmss_tpu.serve.handoff import pick_decode_worker
 from llmss_tpu.serve.protocol import (
+    SLO_CLASS_BATCH,
+    SLO_CLASS_INTERACTIVE,
+    SLO_CLASS_STANDARD,
     STATE_DEAD,
     STATE_READY,
     GenerateRequest,
@@ -125,6 +128,155 @@ def fleet_status(
     return out
 
 
+def interactive_burn(slo_payload: dict) -> float:
+    """The brownout controller's input signal from an ``evaluate_slos``
+    payload: the worst burn rate across windows of the interactive-class
+    TTFT objective — falling back to the base TTFT objective when no
+    per-class series exist yet (cold fleet). 0.0 when there is no data:
+    an empty fleet must read as healthy, not as an emergency."""
+    rows = {
+        r["name"]: r for r in (slo_payload.get("objectives") or ())
+    }
+    best_key = None
+    for name in rows:
+        if "ttft" not in name:
+            continue
+        if name.endswith(f"_{SLO_CLASS_INTERACTIVE}"):
+            best_key = name
+            break
+        if best_key is None:
+            best_key = name
+    if best_key is None:
+        return 0.0
+    worst = 0.0
+    for w in (rows[best_key].get("windows") or {}).values():
+        burn = w.get("burn_rate")
+        if burn is not None and w.get("count", 0) > 0:
+            worst = max(worst, burn)
+    return worst
+
+
+class BrownoutController:
+    """Burn-rate-driven degradation ladder (docs/serving.md).
+
+    Watches the interactive-class TTFT burn rate and walks four rungs,
+    shedding the least-valuable work first and NEVER touching
+    interactive traffic until there is nothing else left to shed:
+
+      0 ``normal``        admit everything
+      1 ``cap-batch``     batch requests' ``max_new_tokens`` capped
+      2 ``shed-batch``    batch rejected with 429 + Retry-After
+      3 ``shed-standard`` standard also rejected; interactive still admitted
+
+    Hysteresis is dual-threshold + dwell: escalate when burn > ``high``,
+    de-escalate only after burn < ``low`` has held for ``dwell_s`` — a
+    burst that oscillates around one threshold cannot flap the ladder.
+    Evaluation is lazily time-gated (``check_s``) off the admission path,
+    so per-request overhead is one monotonic read and two compares.
+    """
+
+    LADDER = ("normal", "cap-batch", "shed-batch", "shed-standard")
+
+    def __init__(
+        self,
+        read_burn,
+        *,
+        high: float = 2.0,
+        low: float = 1.0,
+        dwell_s: float = 5.0,
+        check_s: float = 1.0,
+        batch_max_new_cap: int = 64,
+        retry_after_s: int = 2,
+    ):
+        if high <= low:
+            raise ValueError(f"need high > low, got {high} <= {low}")
+        self.read_burn = read_burn
+        self.high = high
+        self.low = low
+        self.dwell_s = dwell_s
+        self.check_s = check_s
+        self.batch_max_new_cap = batch_max_new_cap
+        self.retry_after_s = retry_after_s
+        self._lock = threading.Lock()
+        self._rung = 0  # guarded_by: self._lock
+        self._last_burn = 0.0  # guarded_by: self._lock
+        self._next_check = 0.0  # guarded_by: self._lock
+        # Monotonic stamp of when burn last sat at/above ``low`` — the
+        # dwell clock for de-escalation.
+        self._last_hot = 0.0  # guarded_by: self._lock
+        self._since = time.monotonic()  # guarded_by: self._lock
+        self._transitions = 0  # guarded_by: self._lock
+        self._history: list[dict] = []  # guarded_by: self._lock
+
+    def tick(self, now: float | None = None) -> int:
+        """Re-evaluate the ladder if the check interval has elapsed;
+        returns the current rung. Safe to call on every admission."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if now < self._next_check:
+                return self._rung
+            self._next_check = now + self.check_s
+        burn = float(self.read_burn())
+        with self._lock:
+            self._last_burn = burn
+            if burn >= self.low:
+                self._last_hot = now
+            rung = self._rung
+            if burn > self.high and rung < len(self.LADDER) - 1:
+                rung += 1
+            elif (
+                burn < self.low and rung > 0
+                and now - self._last_hot >= self.dwell_s
+            ):
+                rung -= 1
+            if rung != self._rung:
+                self._transitions += 1
+                self._history.append({
+                    "from": self.LADDER[self._rung],
+                    "to": self.LADDER[rung],
+                    "burn": round(burn, 3),
+                    "at_s": round(now - self._since, 3),
+                })
+                del self._history[:-16]
+                self._rung = rung
+            return self._rung
+
+    def admit(self, req: GenerateRequest) -> tuple[bool, int | None]:
+        """Admission verdict for one request under the current rung:
+        ``(True, None)`` admits (possibly after capping a batch request's
+        ``max_new_tokens`` in place), ``(False, retry_after_s)`` sheds.
+        Interactive is admitted at EVERY rung."""
+        rung = self.tick()
+        if rung == 0 or req.slo_class == SLO_CLASS_INTERACTIVE:
+            return True, None
+        if req.slo_class == SLO_CLASS_BATCH:
+            if rung >= 2:
+                return False, self.retry_after_s
+            req.max_new_tokens = min(
+                req.max_new_tokens, self.batch_max_new_cap
+            )
+            return True, None
+        if req.slo_class == SLO_CLASS_STANDARD and rung >= 3:
+            return False, self.retry_after_s
+        return True, None
+
+    def state(self) -> dict:
+        """Operator view for /fleet and /metrics. ``brownout_state`` is
+        the numeric rung (renders as a Prometheus gauge); the name rides
+        alongside for humans."""
+        with self._lock:
+            return {
+                "brownout_state": self._rung,
+                "state": self.LADDER[self._rung],
+                "burn_rate": round(self._last_burn, 4),
+                "high": self.high,
+                "low": self.low,
+                "dwell_s": self.dwell_s,
+                "transitions_total": self._transitions,
+                "recent_transitions": list(self._history),
+            }
+
+
 class Router:
     """Policy-driven request placement over the broker's worker registry.
 
@@ -167,6 +319,11 @@ class Router:
             "affinity_misses": 0,
         }
         self._routed_by_worker: dict[str, int] = {}  # guarded_by: self._lock
+        # Per-SLO-class submit counts (closed enum — bounded label set).
+        self._by_class: dict[str, int] = {  # guarded_by: self._lock
+            SLO_CLASS_INTERACTIVE: 0, SLO_CLASS_STANDARD: 0,
+            SLO_CLASS_BATCH: 0,
+        }
 
     # -- policies ------------------------------------------------------------
 
@@ -252,6 +409,9 @@ class Router:
         that appears later serves it)."""
         self.check_failover()
         trace.ensure_context(req)
+        with self._lock:
+            if req.slo_class in self._by_class:
+                self._by_class[req.slo_class] += 1
         infos = self._request_targets()
         if not infos:
             with self._lock:
@@ -366,6 +526,7 @@ class Router:
                 **self._counts,
                 "affinity_hit_rate": (hits / total) if total else None,
                 "routed_by_worker": dict(self._routed_by_worker),
+                "submitted_by_class": dict(self._by_class),
             }
 
 

@@ -18,6 +18,7 @@ from urllib.parse import parse_qs, urlsplit
 
 from llmss_tpu.serve.broker import Broker
 from llmss_tpu.serve.protocol import (
+    SLO_CLASS_BATCH,
     STATE_DEAD,
     STATE_DRAINING,
     STATE_READY,
@@ -33,6 +34,43 @@ _PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 # jax.profiler keeps one global trace per process, so one in-flight
 # POST /profile per process is the correct serialization unit.
 _PROFILE_LOCK = threading.Lock()
+
+# Class-aware admission: the fraction of max_queue_depth each class may
+# fill before shedding. Batch saturates at half the backlog so a batch
+# burst leaves queue room for latency-sensitive traffic even before the
+# brownout ladder engages; interactive and standard keep the full depth
+# (standard's behavior — the default class — is unchanged from the
+# pre-class stack).
+CLASS_DEPTH_FRACTION = {SLO_CLASS_BATCH: 0.5}
+
+
+def admission_verdict(
+    req: GenerateRequest, broker: Broker, max_queue_depth: int,
+    brownout=None,
+) -> tuple[int, dict, dict] | None:
+    """Class-aware shed decision shared by both producer frontends:
+    ``None`` admits (a brownout rung may have capped a batch request's
+    ``max_new_tokens`` in place); otherwise ``(status, body, headers)``
+    for the 429. Checked in ladder-first order so a browned-out class
+    reads the brownout reason, not a coincidental queue-depth one."""
+    if brownout is not None:
+        ok, retry_after = brownout.admit(req)
+        if not ok:
+            return 429, {
+                "error": f"brownout: shedding {req.slo_class}",
+                "id": req.id,
+                "brownout_state": brownout.state()["state"],
+            }, {"Retry-After": str(retry_after)}
+    if max_queue_depth:
+        frac = CLASS_DEPTH_FRACTION.get(req.slo_class, 1.0)
+        limit = max(1, int(max_queue_depth * frac))
+        depth = broker.queue_depth()
+        if depth >= limit:
+            return 429, {
+                "error": "queue full", "id": req.id, "queue_depth": depth,
+                "slo_class": req.slo_class,
+            }, {"Retry-After": "1"}
+    return None
 
 
 def collect_trace_exports(broker: Broker) -> list[dict]:
@@ -221,8 +259,21 @@ class ProducerServer:
     def __init__(self, broker: Broker, host: str = "0.0.0.0",
                  port: int = 8000, timeout_s: float = 300.0,
                  max_queue_depth: int = 1024, router=None,
-                 slo_objectives=None):
+                 slo_objectives=None, brownout=None):
         self.broker = broker
+        # Burn-rate-driven brownout ladder: None builds the default
+        # controller fed by this server's own /slo view of interactive
+        # TTFT burn. With no traffic the burn reads 0.0, so the default
+        # controller sits at rung 0 (admit-all) and costs nothing.
+        if brownout is None:
+            from llmss_tpu.serve.fleet import (
+                BrownoutController, interactive_burn,
+            )
+
+            brownout = BrownoutController(
+                lambda: interactive_burn(self.slo()),
+            )
+        self.brownout = brownout
         # SLO objectives served by GET /slo (attainment + burn rates over
         # the windowed fleet series); None = metrics.DEFAULT_SLO_OBJECTIVES.
         self.slo_objectives = slo_objectives
@@ -344,21 +395,23 @@ class ProducerServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return False
-                if (
-                    outer.max_queue_depth
-                    and outer.broker.queue_depth() >= outer.max_queue_depth
-                ):
+                outer.brownout.tick()
+                verdict = admission_verdict(
+                    req, outer.broker, outer.max_queue_depth,
+                    outer.brownout,
+                )
+                if verdict is not None:
+                    code, payload, headers = verdict
                     trace.record(
                         req.id, "reject", trace_id=req.trace_id,
-                        reason="queue full",
+                        reason=payload.get("error", "shed"),
+                        slo_class=req.slo_class,
                     )
-                    body = json.dumps({
-                        "error": "queue full", "id": req.id,
-                        "queue_depth": outer.broker.queue_depth(),
-                    }).encode()
-                    self.send_response(429)
+                    body = json.dumps(payload).encode()
+                    self.send_response(code)
                     self.send_header("Content-Type", "application/json")
-                    self.send_header("Retry-After", "1")
+                    for k, v in headers.items():
+                        self.send_header(k, v)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
@@ -529,12 +582,14 @@ class ProducerServer:
 
     def fleet(self) -> dict:
         """GET /fleet: per-worker registry detail + routed queue depths +
-        router stats."""
+        router stats + brownout ladder position."""
         from llmss_tpu.serve.fleet import fleet_status
 
-        return fleet_status(
+        out = fleet_status(
             self.broker, self.router, self.HEARTBEAT_STALE_FACTOR,
         )
+        out["brownout"] = self.brownout.state()
+        return out
 
     def metrics_payload(self) -> dict:
         """The GET /metrics JSON payload (also the input to the
@@ -542,6 +597,10 @@ class ProducerServer:
         payload = {
             **self.broker.read_metrics(),
             "delivery": self.broker.delivery_stats(),
+            # Closed enum (interactive/standard/batch) — the metric label
+            # set is bounded by construction.
+            "queue_depths_by_class": self.broker.queue_depths_by_class(),
+            "brownout": self.brownout.state(),
         }
         fleet = self.fleet_metrics()
         if fleet is not None:
@@ -654,7 +713,7 @@ class ProducerServer:
 
 def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
                        max_queue_depth: int = 1024, router=None,
-                       slo_objectives=None):
+                       slo_objectives=None, brownout=None):
     """FastAPI variant of the producer (optional dependency, gated).
 
     Full API parity with ``ProducerServer``: POST /generate (JSON or SSE
@@ -677,6 +736,18 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
 
     app = FastAPI()
     hstate = {"saw_supervisor": False, "memo": None, "memo_until": 0.0}
+    if brownout is None:
+        from llmss_tpu.serve.fleet import (
+            BrownoutController, interactive_burn,
+        )
+
+        def _burn() -> float:
+            exports, _src = collect_series_exports(broker)
+            return interactive_burn(
+                metrics_mod.evaluate_slos(exports, slo_objectives),
+            )
+
+        brownout = BrownoutController(_burn)
 
     def _submit(req: GenerateRequest) -> None:
         if router is not None:
@@ -758,16 +829,19 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
                 content={"error": f"worker {state}", "id": req.id},
                 headers={"Retry-After": "1"},
             )
-        if max_queue_depth and broker.queue_depth() >= max_queue_depth:
+        brownout.tick()
+        verdict = admission_verdict(
+            req, broker, max_queue_depth, brownout,
+        )
+        if verdict is not None:
+            code, content, headers = verdict
             trace.record(
                 req.id, "reject", trace_id=req.trace_id,
-                reason="queue full",
+                reason=content.get("error", "shed"),
+                slo_class=req.slo_class,
             )
             return JSONResponse(
-                status_code=429,
-                content={"error": "queue full", "id": req.id,
-                         "queue_depth": broker.queue_depth()},
-                headers={"Retry-After": "1"},
+                status_code=code, content=content, headers=headers,
             )
         if req.deadline_ts is None:
             req.deadline_ts = _time.time() + timeout_s
@@ -802,6 +876,8 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
         payload = {
             **broker.read_metrics(),
             "delivery": broker.delivery_stats(),
+            "queue_depths_by_class": broker.queue_depths_by_class(),
+            "brownout": brownout.state(),
         }
         workers = broker.read_workers()
         if workers or router is not None:
@@ -869,9 +945,11 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
     def fleet():
         from llmss_tpu.serve.fleet import fleet_status
 
-        return fleet_status(
+        out = fleet_status(
             broker, router, ProducerServer.HEARTBEAT_STALE_FACTOR,
         )
+        out["brownout"] = brownout.state()
+        return out
 
     @app.get("/dlq")
     def dlq():

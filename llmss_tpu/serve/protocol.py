@@ -31,6 +31,18 @@ STATE_DRAINING = "draining"
 STATE_DEAD = "dead"
 WORKER_STATES = (STATE_STARTING, STATE_READY, STATE_DRAINING, STATE_DEAD)
 
+# SLO classes, in strict priority order (most latency-sensitive first).
+# A CLOSED enum: brokers key queues on it, the scheduler maps it to a
+# preemption rank, and metrics emit one label per class — an open set
+# would make queue keys and metric labels unbounded.
+SLO_CLASS_INTERACTIVE = "interactive"
+SLO_CLASS_STANDARD = "standard"
+SLO_CLASS_BATCH = "batch"
+SLO_CLASSES = (SLO_CLASS_INTERACTIVE, SLO_CLASS_STANDARD, SLO_CLASS_BATCH)
+# class -> scheduler priority rank (0 = highest). Lower rank preempts
+# strictly higher rank; equal ranks never preempt each other (livelock).
+SLO_CLASS_RANK = {c: i for i, c in enumerate(SLO_CLASSES)}
+
 
 def prefix_hash(token_ids) -> str:
     """Stable identity for a shared prompt prefix (system prompt / session
@@ -88,6 +100,20 @@ class GenerateRequest:
     # of the original queue lease).
     trace_id: str | None = None
     trace_attempt: int = 0
+    # SLO class (closed enum, see SLO_CLASSES): drives class-tiered queue
+    # drain order in both brokers, preemption rank in the scheduler, the
+    # brownout ladder in the producer, and per-class SLO accounting.
+    slo_class: str = SLO_CLASS_STANDARD
+    # Preemption bookkeeping (worker-stamped): how many times a running
+    # row for this request was evicted for a higher class. Unlike
+    # delivery_attempts this never feeds the DLQ — preemption is the
+    # scheduler's fault, not the request's.
+    preemptions: int = 0
+    # Tokens already emitted before a preemption. The resuming worker
+    # replays them as chunked prefill (prompt + resume_tokens) and only
+    # decodes the remainder — greedy streams stay identical to an
+    # unpreempted run because sampling depends only on (seed, position).
+    resume_tokens: list[int] | None = None
     id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
 
     def to_json(self) -> str:
@@ -111,6 +137,19 @@ class GenerateRequest:
                 raise ValueError("top_k must be >= 0")
         if self.max_new_tokens <= 0:
             raise ValueError("max_new_tokens must be > 0")
+        if self.slo_class not in SLO_CLASSES:
+            raise ValueError(
+                f"slo_class must be one of {SLO_CLASSES}, "
+                f"got {self.slo_class!r}"
+            )
+        if self.resume_tokens is not None and (
+            len(self.resume_tokens) >= self.max_new_tokens
+        ):
+            raise ValueError(
+                "resume_tokens must be shorter than max_new_tokens "
+                "(a fully-decoded request would have been answered, "
+                "not preempted)"
+            )
         if self.prefix_token_ids is not None:
             if self.token_ids is None:
                 raise ValueError("prefix_token_ids requires token_ids")

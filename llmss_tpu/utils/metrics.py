@@ -118,6 +118,10 @@ class EngineMetrics:
         self.cancelled = 0  # guarded_by: self._lock
         self.deadline_expired = 0  # guarded_by: self._lock
         self.poisoned = 0  # guarded_by: self._lock
+        # Rows evicted mid-decode for a higher SLO class (the request is
+        # refunded to the broker and resumes later — not a terminal
+        # disposition, so it is NOT in finish_classes).
+        self.preempted = 0  # guarded_by: self._lock
         # Paged-KV block-pool gauges (kv_layout="paged"): pool capacity,
         # live blocks, and idle-prefix evictions. Zero on dense engines.
         self.kv_blocks_total = 0  # guarded_by: self._lock
@@ -166,6 +170,11 @@ class EngineMetrics:
         (per-row NaN/inf containment — the co-batched rows kept going)."""
         with self._lock:
             self.poisoned += n
+
+    def add_preempted(self, n: int = 1) -> None:
+        """Rows evicted mid-decode to admit a higher-SLO-class request."""
+        with self._lock:
+            self.preempted += n
 
     def set_kv_blocks(
         self, total: int | None = None, in_use: int | None = None,
@@ -224,9 +233,10 @@ class EngineMetrics:
     def to_dict(self) -> dict:
         uptime = time.monotonic() - self._start
         with self._lock:
-            toks, reqs, errs, canc, exp, pois = (
+            toks, reqs, errs, canc, exp, pois, preempt = (
                 self.tokens_generated, self.requests_served, self.errors,
                 self.cancelled, self.deadline_expired, self.poisoned,
+                self.preempted,
             )
             kv_total, kv_used, kv_evic = (
                 self.kv_blocks_total, self.kv_blocks_in_use,
@@ -248,6 +258,7 @@ class EngineMetrics:
             "cancelled": canc,
             "deadline_expired": exp,
             "poisoned_rows": pois,
+            "preempted_rows": preempt,
             "kv_blocks_total": kv_total,
             "kv_blocks_in_use": kv_used,
             "kv_block_evictions": kv_evic,
@@ -691,6 +702,10 @@ def timeseries_payload(exports, sources: dict | None = None) -> dict:
 # 1 h window catches slow burns.
 SLO_WINDOWS = (("5m", 300.0), ("1h", 3600.0))
 
+# SLO classes, mirroring serve.protocol.SLO_CLASSES (utils must not import
+# serve). A closed enum: per-class series names are bounded by construction.
+SLO_CLASS_SERIES = ("interactive", "standard", "batch")
+
 DEFAULT_SLO_OBJECTIVES = (
     {
         "name": "ttft_p95_500ms", "kind": "latency", "series": "ttft_s",
@@ -704,6 +719,24 @@ DEFAULT_SLO_OBJECTIVES = (
         "name": "terminal_error_rate", "kind": "error_rate",
         "total_series": "requests_total", "bad_series": "requests_error",
         "target": 0.999,
+    },
+    # Per-class TTFT objectives over the class-suffixed series fed by
+    # observe_request_cost. The interactive one is the brownout
+    # controller's steering signal (fleet.interactive_burn finds it by
+    # its ``_interactive`` suffix); the looser standard/batch targets
+    # make class-by-class degradation visible on /slo.
+    {
+        "name": "ttft_p95_500ms_interactive", "kind": "latency",
+        "series": "ttft_s_interactive", "threshold_ms": 500.0,
+        "target": 0.95,
+    },
+    {
+        "name": "ttft_p95_2s_standard", "kind": "latency",
+        "series": "ttft_s_standard", "threshold_ms": 2000.0, "target": 0.95,
+    },
+    {
+        "name": "ttft_p95_15s_batch", "kind": "latency",
+        "series": "ttft_s_batch", "threshold_ms": 15000.0, "target": 0.95,
     },
 )
 
@@ -801,6 +834,14 @@ _COST_COUNTERS = (
     ("handoff_bytes", "handoff_bytes"),
     ("kv_block_s", "kv_block_seconds"),
     ("reprefills", "reprefills"),
+    ("preemptions", "preemptions_total"),
+)
+# RequestCost field -> per-class histogram series stem: a record tagged
+# slo_class=interactive also feeds ttft_s_interactive / e2e_s_interactive,
+# which the per-class SLO objectives read.
+_COST_CLASS_HISTOGRAMS = (
+    ("ttft_s", "ttft_s"),
+    ("total_s", "e2e_s"),
 )
 
 
@@ -816,8 +857,15 @@ def observe_request_cost(cost: dict, registry: SeriesRegistry | None = None):
             reg.counter("requests_error"),
             tuple((f, reg.histogram(n)) for f, n in _COST_HISTOGRAMS),
             tuple((f, reg.counter(n)) for f, n in _COST_COUNTERS),
+            {
+                cls: tuple(
+                    (f, reg.histogram(f"{n}_{cls}"))
+                    for f, n in _COST_CLASS_HISTOGRAMS
+                )
+                for cls in SLO_CLASS_SERIES
+            },
         )
-    total, errors, hists, counters = sinks
+    total, errors, hists, counters, class_hists = sinks
     # One clock read and one slot computation shared by every sink —
     # registry-created series all use the default ring geometry.
     now = time.monotonic()
@@ -835,6 +883,10 @@ def observe_request_cost(cost: dict, registry: SeriesRegistry | None = None):
         v = get(field)
         if v:
             c._add_at(i, epoch, v)
+    for field, h in class_hists.get(get("slo_class"), ()):
+        v = get(field)
+        if v is not None and v >= 0:
+            h._observe_at(i, epoch, v)
 
 
 # Shape signature of LatencyStat.to_dict — rendered as a quantile family

@@ -423,6 +423,8 @@ def request_cost(events, assume_sorted: bool = False) -> dict | None:
     fin_tokens = 0
     attempts = 0
     reprefills = 0
+    preemptions = 0
+    slo_class = None
     trace_id = None
     for e in evs:
         name = e["name"]
@@ -435,6 +437,8 @@ def request_cost(events, assume_sorted: bool = False) -> dict | None:
         if name == "enqueue":
             if enq_t is None:
                 enq_t = t
+            if slo_class is None and a:
+                slo_class = a.get("slo_class")
         elif name == "lease":
             if lease_t is None:
                 lease_t = t
@@ -463,6 +467,11 @@ def request_cost(events, assume_sorted: bool = False) -> dict | None:
                 kv_block_s += a.get("kv_block_s", 0.0)
         elif name == "reprefill":
             reprefills += 1
+        elif name == "preempt":
+            # Broker-side refund events only — the scheduler's paired
+            # "evict" is deliberately not counted (one preemption, two
+            # vantage points).
+            preemptions += 1
         elif name in TERMINAL_EVENTS:
             term_t = t
             t_attrs = a or {}
@@ -501,6 +510,8 @@ def request_cost(events, assume_sorted: bool = False) -> dict | None:
         "kv_block_s": _r(kv_block_s) or None,
         "attempts": attempts or 1,
         "reprefills": reprefills,
+        "preemptions": preemptions,
+        "slo_class": slo_class,
         "n_events": len(evs),
     }
 
@@ -548,8 +559,8 @@ def export_workload(exports) -> dict:
     Each retained request becomes one row keyed by its FIRST ``enqueue``
     (re-routes and re-prefills are delivery mechanics, not arrivals);
     ``arrival_s`` offsets are relative to the earliest arrival so replay
-    is start-time independent. ``priority`` is reserved for the SLO-tiered
-    scheduler.
+    is start-time independent. ``slo_class`` carries each arrival's
+    scheduling class so a replay reproduces the priority mix.
     """
     by_req: dict[str, list[dict]] = {}
     for e in stitch(exports):
@@ -566,7 +577,7 @@ def export_workload(exports) -> dict:
             "prompt_len": a.get("plen"),
             "max_new_tokens": a.get("max_new"),
             "prefix_hash": a.get("prefix"),
-            "priority": None,
+            "slo_class": a.get("slo_class"),
         })
     rows.sort(key=lambda r: r["_arrival_ts"])
     t0 = rows[0]["_arrival_ts"] if rows else 0.0

@@ -1,0 +1,717 @@
+"""SLO-class scheduling, paged-KV preemption, and brownout (ISSUE 14).
+
+Pins the graceful-degradation contract end to end:
+
+- Brokers drain class queues in strict priority order, and the
+  preemption requeue path mirrors ``release_requests`` refund semantics:
+  a request evicted N times for higher-priority work never inches toward
+  the DLQ (both ``InProcBroker`` and ``RedisBroker``-over-``FakeRedis``).
+- The scheduler's eviction + chunked-prefill resume is loss-free and
+  stream-identical: a preempted greedy request's final tokens equal the
+  never-preempted run, COW prefix refcounts balance, and the warmed
+  engine keys zero new compiles with preemption active (CompileGuard).
+- A worker killed while holding a preempted-but-not-yet-resumed request
+  still yields exactly one terminal response with the full stream.
+- The brownout ladder degrades batch before standard before interactive
+  (interactive is never shed), with dual-threshold + dwell hysteresis,
+  and both producer frontends surface it via 429 + Retry-After.
+"""
+
+import threading
+import time
+
+import pytest
+
+from llmss_tpu.engine import DecodeEngine, GenerationParams
+from llmss_tpu.engine.scheduler import ContinuousBatcher
+from llmss_tpu.models.common import DecoderConfig
+from llmss_tpu.models.decoder import init_params
+from llmss_tpu.parallel import MeshPlan, make_mesh
+from llmss_tpu.serve.broker import InProcBroker, RedisBroker
+from llmss_tpu.serve.chaos import FakeRedis, ScriptedEngine
+from llmss_tpu.serve.consumer import ContinuousWorker, Worker
+from llmss_tpu.serve.fleet import BrownoutController, interactive_burn
+from llmss_tpu.serve.producer import ProducerServer, admission_verdict
+from llmss_tpu.serve.protocol import (
+    SLO_CLASS_BATCH,
+    SLO_CLASS_INTERACTIVE,
+    SLO_CLASS_STANDARD,
+    GenerateRequest,
+    GenerateResponse,
+)
+from llmss_tpu.utils import metrics as metrics_mod
+from llmss_tpu.utils import trace
+
+
+def make_broker(kind, **kw):
+    if kind == "inproc":
+        return InProcBroker(**kw)
+    return RedisBroker(client=FakeRedis(), worker_id="w0", **kw)
+
+
+BROKERS = ("inproc", "fakeredis")
+
+
+# -- protocol ----------------------------------------------------------------
+
+
+def test_request_validates_slo_class_and_resume():
+    GenerateRequest(token_ids=[1], slo_class=SLO_CLASS_BATCH).validate()
+    with pytest.raises(ValueError):
+        GenerateRequest(token_ids=[1], slo_class="vip").validate()
+    with pytest.raises(ValueError):
+        GenerateRequest(
+            token_ids=[1], max_new_tokens=2, resume_tokens=[5, 6],
+        ).validate()  # resume must leave >= 1 token to generate
+
+
+# -- broker: class queues + preemption refund --------------------------------
+
+
+@pytest.mark.parametrize("kind", BROKERS)
+def test_class_queues_drain_in_priority_order(kind):
+    b = make_broker(kind)
+    b.push_request(GenerateRequest(
+        id="b1", token_ids=[1], slo_class=SLO_CLASS_BATCH))
+    b.push_request(GenerateRequest(
+        id="s1", token_ids=[1], slo_class=SLO_CLASS_STANDARD))
+    b.push_request(GenerateRequest(
+        id="i1", token_ids=[1], slo_class=SLO_CLASS_INTERACTIVE))
+    assert b.queue_depths_by_class() == {
+        SLO_CLASS_INTERACTIVE: 1, SLO_CLASS_STANDARD: 1, SLO_CLASS_BATCH: 1,
+    }
+    assert b.queue_depth() == 3
+    assert [b.pop_request().id for _ in range(3)] == ["i1", "s1", "b1"]
+
+
+@pytest.mark.parametrize("kind", BROKERS)
+def test_preempt_refunds_attempt_and_never_dlqs(kind):
+    b = make_broker(kind, lease_s=30.0, max_delivery_attempts=2)
+    b.push_request(GenerateRequest(id="r1", token_ids=[1], max_new_tokens=8))
+    for i in range(5):
+        req = b.pop_request()
+        # The refund means every re-lease is attempt 1 — N preemptions
+        # never approach max_delivery_attempts.
+        assert req.id == "r1" and req.delivery_attempts == 1, i
+        req.resume_tokens = list(range(i + 1))
+        req.preemptions += 1
+        assert b.preempt_requests([req]) == 1
+    assert b.dlq_depth() == 0
+    assert b.delivery_stats()["preempted"] == 5
+    req = b.pop_request()
+    assert req.preemptions == 5 and req.resume_tokens == [0, 1, 2, 3, 4]
+    b.push_response(GenerateResponse(id="r1", token_ids=[2]))
+    assert b.wait_response("r1", timeout=1).token_ids == [2]
+    assert b.wait_response("r1", timeout=0.1) is None  # exactly one
+
+
+@pytest.mark.parametrize("kind", BROKERS)
+def test_preempted_request_requeues_at_class_head(kind):
+    b = make_broker(kind)
+    b.push_request(GenerateRequest(id="s1", token_ids=[1]))
+    b.push_request(GenerateRequest(id="s2", token_ids=[1]))
+    req = b.pop_request()
+    assert req.id == "s1"
+    b.preempt_requests([req])
+    # Oldest work in its class: s1 resumes before s2 is started.
+    assert b.pop_request().id == "s1"
+    assert b.pop_request().id == "s2"
+
+
+@pytest.mark.parametrize("kind", BROKERS)
+def test_preempt_unleased_request_is_noop(kind):
+    b = make_broker(kind)
+    # Lease already reaped / request settled: the stale preempt loses.
+    assert b.preempt_requests(
+        [GenerateRequest(id="ghost", token_ids=[1])]
+    ) == 0
+    assert b.queue_depth() == 0
+    assert b.delivery_stats().get("preempted", 0) == 0
+
+
+@pytest.mark.parametrize("kind", BROKERS)
+def test_kill_holding_preempted_request_one_terminal(kind):
+    """Worker A preempts a request (refund + requeue) and dies before it
+    resumes anywhere; worker B leases it and must produce exactly one
+    terminal response with the full unpreempted stream."""
+    if kind == "inproc":
+        b = InProcBroker(lease_s=0.05)
+        wb = b
+    else:
+        server = FakeRedis()
+        b = RedisBroker(client=server, worker_id="prod", lease_s=0.05)
+        wb = RedisBroker(client=server, worker_id="w1", lease_s=0.05)
+    prompt = [7, 11]
+    b.push_request(GenerateRequest(
+        id="r1", token_ids=list(prompt), max_new_tokens=4,
+        slo_class=SLO_CLASS_INTERACTIVE,
+    ))
+    full = ScriptedEngine.expected_tokens(prompt, 4)
+
+    # Worker A: leases, makes partial progress, preempts, dies (no ack,
+    # no abort — the broker object is simply abandoned).
+    req = b.pop_request()
+    req.resume_tokens = full[:2]
+    req.preemptions += 1
+    assert b.preempt_requests([req]) == 1
+
+    # Worker B resumes: replays the emitted tokens, continues the stream.
+    w = Worker(
+        ScriptedEngine(), wb, batch_size=1, poll_timeout_s=0.01,
+        pad_batch=False,
+    )
+    w.run_once()
+    resp = b.wait_response("r1", timeout=2)
+    assert resp is not None and resp.error is None
+    assert resp.token_ids == full  # zero lost, zero duplicated tokens
+    assert b.wait_response("r1", timeout=0.2) is None  # exactly one
+    time.sleep(0.06)
+    assert b.reap_expired() == 0  # settled: nothing left to redeliver
+    assert b.dlq_depth() == 0
+
+
+# -- scheduler: eviction + chunked-prefill resume ----------------------------
+
+
+def _cfg(**kw):
+    base = dict(
+        model_type="llama", vocab_size=64, hidden_size=32, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=8, intermediate_size=64,
+        max_position_embeddings=64, activation="silu", norm="rmsnorm",
+        norm_eps=1e-5, mlp="swiglu", positions="rotary", rope_style="half",
+        rotary_dim=8, attn_bias=False, mlp_bias=False,
+        tie_word_embeddings=False, dtype="float32",
+    )
+    base.update(kw)
+    return DecoderConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def setup(devices):
+    import jax
+
+    cfg = _cfg()
+    mesh = make_mesh(MeshPlan(dp=2, tp=4))
+    params = init_params(cfg, mesh, jax.random.key(0))
+    return cfg, mesh, params
+
+
+@pytest.fixture(scope="module")
+def dense_engine(setup):
+    cfg, mesh, params = setup
+    return DecodeEngine(cfg, params, mesh, max_seq_len=64)
+
+
+def _cb_into(got, key):
+    def cb(toks, cancelled=False, error=None):
+        got[key] = list(toks)
+    return cb
+
+
+def _preempt_cycle(batcher, dense_got, *, p_low, p_hi, gen_low, gen_hi):
+    """Run one evict-and-resume cycle: low-priority request mid-decode,
+    interactive arrival forces the eviction, low resumes with its emitted
+    tokens replayed. Returns the evicted-token count."""
+    evicted = {}
+    batcher.preempt_cb = (
+        lambda rid, toks: evicted.__setitem__(rid, list(toks))
+    )
+    batcher.submit(
+        p_low, gen_low, _cb_into(dense_got, "low"), req_id="low",
+        priority=2,
+    )
+    for _ in range(3):  # low is mid-decode (first token resolved)
+        batcher.step()
+    batcher.submit(
+        p_hi, gen_hi, _cb_into(dense_got, "hi"), req_id="hi", priority=0,
+    )
+    batcher.step()  # eviction frees the slot that admits "hi"
+    assert "low" in evicted, "interactive arrival did not preempt"
+    toks = evicted["low"]
+    assert 0 < len(toks) < gen_low.max_new_tokens
+    # Resume exactly as the consumer does: prompt + emitted tokens, the
+    # remaining budget, and replayed= so the stream is not re-emitted.
+    batcher.submit(
+        p_low + toks,
+        GenerationParams(
+            max_new_tokens=gen_low.max_new_tokens - len(toks),
+            is_greedy=True,
+        ),
+        _cb_into(dense_got, "low"), req_id="low", priority=2,
+        replayed=len(toks),
+    )
+    batcher.run_until_idle()
+    return len(toks)
+
+
+def test_preempt_resume_stream_identical(dense_engine):
+    """The acceptance assertion: a preempted greedy request's final token
+    stream equals the unpreempted run exactly."""
+    gen_low = GenerationParams(max_new_tokens=12, is_greedy=True)
+    gen_hi = GenerationParams(max_new_tokens=4, is_greedy=True)
+    p_low, p_hi = [1, 2, 3], [9, 8, 7]
+    exp_low = dense_engine.generate([p_low], gen_low)[0]
+    exp_hi = dense_engine.generate([p_hi], gen_hi)[0]
+
+    before = dense_engine.metrics.preempted
+    b = ContinuousBatcher(dense_engine, rows=1)
+    got = {}
+    n_evicted = _preempt_cycle(
+        b, got, p_low=p_low, p_hi=p_hi, gen_low=gen_low, gen_hi=gen_hi,
+    )
+    assert dense_engine.metrics.preempted == before + 1
+    assert got["hi"] == exp_hi
+    assert got["low"] == exp_low, (n_evicted, got["low"], exp_low)
+
+
+def test_preempt_without_cb_is_disabled(dense_engine):
+    """preempt_cb=None (FIFO deployments): an interactive arrival behind
+    a busy batcher waits its turn — nothing is evicted."""
+    before = dense_engine.metrics.preempted
+    b = ContinuousBatcher(dense_engine, rows=1)
+    got = {}
+    gen = GenerationParams(max_new_tokens=6, is_greedy=True)
+    b.submit([1, 2], gen, _cb_into(got, "low"), req_id="low", priority=2)
+    for _ in range(3):
+        b.step()
+    b.submit([3, 4], gen, _cb_into(got, "hi"), req_id="hi", priority=0)
+    b.run_until_idle()
+    assert dense_engine.metrics.preempted == before
+    assert len(got["low"]) == 6 and len(got["hi"]) == 6
+
+
+def test_preempt_paged_cow_refcounts_balance(setup, dense_engine):
+    """Evicting a row that shares a COW prefix releases its owned blocks
+    and decrefs the shared ones; the resume re-increfs them. After the
+    dust settles only the prefix registry's block remains — refcounts
+    balance and the streams are still bit-identical to dense."""
+    cfg, mesh, params = setup
+    eng = DecodeEngine(
+        cfg, params, mesh, max_seq_len=64, kv_layout="paged",
+        block_size=16, kv_blocks=4,
+    )
+    pfx_tokens = list(range(1, 21))  # 1 shared full block + partial tail
+    pfx = eng.build_prefix(pfx_tokens)
+    gen_low = GenerationParams(max_new_tokens=10, is_greedy=True)
+    gen_hi = GenerationParams(max_new_tokens=45, is_greedy=True)
+    p_low = pfx_tokens + [30]
+    p_hi = [40, 41, 42]  # 3+45=48 tokens -> 3 blocks: exceeds the free 2
+    exp_low = dense_engine.generate([p_low], gen_low)[0]
+    exp_hi = dense_engine.generate([p_hi], gen_hi)[0]
+
+    b = ContinuousBatcher(eng, rows=2)
+    evicted = {}
+    b.preempt_cb = lambda rid, toks: evicted.__setitem__(rid, list(toks))
+    got = {}
+    b.submit(
+        p_low, gen_low, _cb_into(got, "low"), req_id="low", prefix=pfx,
+        priority=2,
+    )
+    for _ in range(3):
+        b.step()
+    assert b.allocator.blocks_in_use == 2  # 1 shared (registry) + 1 owned
+    b.submit(
+        p_hi, gen_hi, _cb_into(got, "hi"), req_id="hi", priority=0,
+    )
+    b.step()  # block-pool pressure (not row pressure) forces the evict
+    assert "low" in evicted
+    toks = evicted["low"]
+    b.submit(
+        p_low + toks,
+        GenerationParams(
+            max_new_tokens=gen_low.max_new_tokens - len(toks),
+            is_greedy=True,
+        ),
+        _cb_into(got, "low"), req_id="low", prefix=pfx, priority=2,
+        replayed=len(toks),
+    )
+    b.run_until_idle()
+    assert got["hi"] == exp_hi
+    assert got["low"] == exp_low
+    # Balance: every evict/resume incref-decref pair cancelled; only the
+    # prefix registry's shared block is still held.
+    assert b.allocator.blocks_in_use == 1
+    assert eng.metrics.to_dict()["kv_blocks_in_use"] == 1
+    assert eng.metrics.preempted == 1
+
+
+def test_no_steady_state_recompiles_with_preemption(dense_engine):
+    """A warmed batcher running the full evict + chunked-replay-resume
+    cycle — with a brownout controller ticking on the side — must never
+    key a fresh compile: eviction is host bookkeeping and a resumed row
+    admits through the same padded-prefill programs as any admission."""
+    from llmss_tpu.analysis import CompileGuard
+
+    gen_low = GenerationParams(max_new_tokens=12, is_greedy=True)
+    gen_hi = GenerationParams(max_new_tokens=4, is_greedy=True)
+    p_low, p_hi = [1, 2, 3], [9, 8, 7]
+
+    def cycle():
+        b = ContinuousBatcher(dense_engine, rows=1)
+        got = {}
+        _preempt_cycle(
+            b, got, p_low=p_low, p_hi=p_hi, gen_low=gen_low, gen_hi=gen_hi,
+        )
+        return got
+
+    cycle()  # warmup: compiles are expected here
+    ctrl = BrownoutController(lambda: 0.0, check_s=0.0)
+    guard = CompileGuard.for_engine(dense_engine)
+    assert guard._fns, "engine exposes no jitted callables to guard"
+    with guard.steady_state():
+        got = cycle()
+        ctrl.tick()
+        assert len(got) == 2
+
+
+def test_continuous_worker_preempt_roundtrip(dense_engine):
+    """End-to-end through the broker: a batch-class request mid-decode is
+    preempted by an interactive arrival, refunded to the broker with its
+    resume point, re-leased, and finishes with the exact unpreempted
+    greedy stream."""
+    gen_low = GenerationParams(max_new_tokens=20, is_greedy=True)
+    gen_hi = GenerationParams(max_new_tokens=4, is_greedy=True)
+    p_low, p_hi = [2, 4, 6], [5, 3, 1]
+    exp_low = dense_engine.generate([p_low], gen_low)[0]
+    exp_hi = dense_engine.generate([p_hi], gen_hi)[0]
+
+    broker = InProcBroker()
+    worker = ContinuousWorker(
+        dense_engine, broker, rows=1, poll_timeout_s=0.01,
+    )
+    stop = threading.Event()
+    t = threading.Thread(
+        target=worker.run_forever, args=(stop,), daemon=True,
+    )
+    t.start()
+    try:
+        low = GenerateRequest(
+            id="low", token_ids=list(p_low), max_new_tokens=20,
+            is_greedy=True, slo_class=SLO_CLASS_BATCH,
+        )
+        broker.push_request(low)
+        # Wait until low is actually decoding before the interactive
+        # request arrives, so the eviction path (not queue order) serves
+        # the priority.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if broker.delivery_stats()["inflight"] >= 1:
+                break
+            time.sleep(0.005)
+        time.sleep(0.1)  # a few decode chunks of progress
+        hi = GenerateRequest(
+            id="hi", token_ids=list(p_hi), max_new_tokens=4,
+            is_greedy=True, slo_class=SLO_CLASS_INTERACTIVE,
+        )
+        broker.push_request(hi)
+        resp_hi = broker.wait_response("hi", timeout=60)
+        resp_low = broker.wait_response("low", timeout=60)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert resp_hi is not None and resp_hi.error is None
+    assert resp_hi.token_ids == exp_hi
+    assert resp_low is not None and resp_low.error is None
+    assert resp_low.token_ids == exp_low
+    assert broker.delivery_stats()["preempted"] >= 1
+    assert broker.dlq_depth() == 0
+
+
+# -- brownout controller -----------------------------------------------------
+
+
+def _forced(rung, **kw):
+    """A controller pinned at ``rung``: escalations are driven with
+    explicit far-future ticks, then the huge check interval time-gates
+    every real-time tick so admissions see a constant rung."""
+    kw.setdefault("check_s", 1e9)
+    ctrl = BrownoutController(lambda: 99.0, **kw)
+    for i in range(rung):
+        ctrl.tick(now=(i + 1) * 4e9)
+    assert ctrl.state()["brownout_state"] == rung
+    return ctrl
+
+
+def test_brownout_ladder_escalates_and_recovers():
+    burn = [9.0]
+    ctrl = BrownoutController(
+        lambda: burn[0], high=2.0, low=1.0, dwell_s=2.0, check_s=0.0,
+    )
+    names = BrownoutController.LADDER
+    # One rung per check while burning hot; clamps at the top.
+    assert [ctrl.tick(now=t) for t in (1, 2, 3, 4)] == [1, 2, 3, 3]
+    assert ctrl.state()["state"] == names[3]
+    # Cool: de-escalation waits out the dwell from the last hot reading
+    # (t=4), then walks down one rung per check.
+    burn[0] = 0.1
+    assert ctrl.tick(now=5) == 3  # only 1s cool < dwell 2s
+    assert [ctrl.tick(now=t) for t in (6, 7, 8, 9)] == [2, 1, 0, 0]
+    st = ctrl.state()
+    assert st["state"] == "normal" and st["transitions_total"] == 6
+    assert len(st["recent_transitions"]) == 6
+
+
+def test_brownout_hysteresis_no_flapping():
+    """Burn between low and high: never escalates, and keeps refreshing
+    the dwell clock so it never de-escalates either."""
+    burn = [9.0]
+    ctrl = BrownoutController(
+        lambda: burn[0], high=2.0, low=1.0, dwell_s=2.0, check_s=0.0,
+    )
+    assert ctrl.tick(now=1) == 1
+    burn[0] = 1.5  # hot enough to hold, not hot enough to climb
+    assert [ctrl.tick(now=t) for t in (2, 3, 4, 5, 6)] == [1] * 5
+    burn[0] = 0.1
+    assert ctrl.tick(now=7) == 1  # dwell not yet served (last hot t=6)
+    assert ctrl.tick(now=8.5) == 0
+
+
+def test_brownout_admit_order_batch_standard_interactive():
+    """The ladder's whole point: batch degrades before standard, and
+    interactive is admitted at EVERY rung."""
+    def reqs():
+        return {
+            cls: GenerateRequest(
+                token_ids=[1], max_new_tokens=500, slo_class=cls,
+            )
+            for cls in (
+                SLO_CLASS_INTERACTIVE, SLO_CLASS_STANDARD, SLO_CLASS_BATCH,
+            )
+        }
+
+    r = reqs()
+    ctrl = _forced(1, batch_max_new_cap=64, retry_after_s=3)
+    assert ctrl.admit(r[SLO_CLASS_INTERACTIVE]) == (True, None)
+    assert ctrl.admit(r[SLO_CLASS_STANDARD]) == (True, None)
+    assert ctrl.admit(r[SLO_CLASS_BATCH]) == (True, None)
+    assert r[SLO_CLASS_BATCH].max_new_tokens == 64  # capped in place
+    assert r[SLO_CLASS_STANDARD].max_new_tokens == 500
+
+    r = reqs()
+    ctrl = _forced(2, retry_after_s=3)
+    assert ctrl.admit(r[SLO_CLASS_BATCH]) == (False, 3)
+    assert ctrl.admit(r[SLO_CLASS_STANDARD]) == (True, None)
+    assert ctrl.admit(r[SLO_CLASS_INTERACTIVE]) == (True, None)
+
+    r = reqs()
+    ctrl = _forced(3, retry_after_s=3)
+    assert ctrl.admit(r[SLO_CLASS_BATCH]) == (False, 3)
+    assert ctrl.admit(r[SLO_CLASS_STANDARD]) == (False, 3)
+    assert ctrl.admit(r[SLO_CLASS_INTERACTIVE]) == (True, None)
+
+
+def test_interactive_burn_reads_slo_payload():
+    payload = {"objectives": [
+        {"name": "e2e_p95_5s", "windows": {
+            "5m": {"burn_rate": 50.0, "count": 9}}},
+        {"name": "ttft_p95_500ms", "windows": {
+            "5m": {"burn_rate": 1.0, "count": 9}}},
+        {"name": "ttft_p95_500ms_interactive", "windows": {
+            "5m": {"burn_rate": 4.0, "count": 3},
+            "1h": {"burn_rate": 2.0, "count": 3},
+        }},
+    ]}
+    # Prefers the interactive-class objective; takes the worst window.
+    assert interactive_burn(payload) == 4.0
+    # Windows with no observations are not alerts.
+    assert interactive_burn({"objectives": [
+        {"name": "ttft_p95_500ms_interactive", "windows": {
+            "5m": {"burn_rate": None, "count": 0}}},
+    ]}) == 0.0
+    # Falls back to the base TTFT objective when no per-class series.
+    assert interactive_burn({"objectives": [
+        {"name": "ttft_p95_500ms", "windows": {
+            "5m": {"burn_rate": 1.5, "count": 2}}},
+    ]}) == 1.5
+    assert interactive_burn({}) == 0.0  # empty fleet reads healthy
+
+
+# -- producer admission ------------------------------------------------------
+
+
+def test_admission_verdict_class_depth_fraction():
+    b = InProcBroker()
+    b.push_request(GenerateRequest(id="old", token_ids=[1]))
+    # depth 1 vs max 2: batch's 0.5 fraction sheds at depth 1, while
+    # standard and interactive still have headroom.
+    batch = GenerateRequest(
+        token_ids=[1], slo_class=SLO_CLASS_BATCH)
+    verdict = admission_verdict(batch, b, 2)
+    assert verdict is not None and verdict[0] == 429
+    assert verdict[2]["Retry-After"] == "1"
+    for cls in (SLO_CLASS_STANDARD, SLO_CLASS_INTERACTIVE):
+        req = GenerateRequest(token_ids=[1], slo_class=cls)
+        assert admission_verdict(req, b, 2) is None
+
+
+def _post(port, path, payload):
+    import http.client
+    import json
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("POST", path, json.dumps(payload),
+                 {"Content-Type": "application/json"})
+    r = conn.getresponse()
+    body = json.loads(r.read() or b"{}")
+    headers = dict(r.getheaders())
+    conn.close()
+    return r.status, body, headers
+
+
+def _get(port, path):
+    import http.client
+    import json
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = json.loads(r.read() or b"{}")
+    conn.close()
+    return r.status, body
+
+
+def _answered(broker):
+    """A stub worker that answers the next queued request."""
+    def run():
+        req = broker.pop_request(timeout=5)
+        if req is not None:
+            broker.push_response(
+                GenerateResponse(id=req.id, token_ids=[1]))
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_producer_brownout_sheds_batch_then_standard_never_interactive():
+    b = InProcBroker()
+    srv = ProducerServer(
+        b, host="127.0.0.1", port=0, timeout_s=5.0, brownout=_forced(2),
+    )
+    srv.start()
+    try:
+        status, body, headers = _post(srv.port, "/generate", {
+            "token_ids": [1], "max_new_tokens": 2,
+            "slo_class": SLO_CLASS_BATCH,
+        })
+        assert status == 429
+        assert "brownout" in body["error"]
+        assert body["brownout_state"] == "shed-batch"
+        assert headers.get("Retry-After") == "2"
+        assert b.queue_depth() == 0  # shed before queueing
+
+        _answered(b)
+        status, _body, _h = _post(srv.port, "/generate", {
+            "token_ids": [1], "max_new_tokens": 2,
+            "slo_class": SLO_CLASS_STANDARD,
+        })
+        assert status == 200  # standard survives rung 2
+
+        # Observability: /metrics and /fleet both carry the ladder state
+        # and the per-class queue depths (closed enum labels).
+        status, m = _get(srv.port, "/metrics")
+        assert m["brownout"]["state"] == "shed-batch"
+        assert m["brownout"]["brownout_state"] == 2
+        assert set(m["queue_depths_by_class"]) == {
+            SLO_CLASS_INTERACTIVE, SLO_CLASS_STANDARD, SLO_CLASS_BATCH,
+        }
+        assert m["delivery"]["preempted"] == 0
+        status, f = _get(srv.port, "/fleet")
+        assert f["brownout"]["state"] == "shed-batch"
+    finally:
+        srv.stop()
+
+    srv = ProducerServer(
+        b, host="127.0.0.1", port=0, timeout_s=5.0, brownout=_forced(3),
+    )
+    srv.start()
+    try:
+        status, body, _h = _post(srv.port, "/generate", {
+            "token_ids": [1], "max_new_tokens": 2,
+            "slo_class": SLO_CLASS_STANDARD,
+        })
+        assert status == 429 and body["brownout_state"] == "shed-standard"
+        _answered(b)
+        status, _body, _h = _post(srv.port, "/generate", {
+            "token_ids": [1], "max_new_tokens": 2,
+            "slo_class": SLO_CLASS_INTERACTIVE,
+        })
+        assert status == 200  # interactive admitted at the last rung
+    finally:
+        srv.stop()
+
+
+# -- SLO plane: per-class series + preemption cost flow ----------------------
+
+
+def test_request_cost_carries_class_and_preemptions():
+    t0 = 100.0
+    events = [
+        {"req_id": "r", "name": "enqueue", "t": t0,
+         "attrs": {"plen": 2, "max_new": 4,
+                   "slo_class": SLO_CLASS_INTERACTIVE}},
+        {"req_id": "r", "name": "lease", "t": t0 + 0.01, "attrs": {}},
+        {"req_id": "r", "name": "admit", "t": t0 + 0.02, "attrs": {}},
+        {"req_id": "r", "name": "preempt", "t": t0 + 0.03,
+         "attrs": {"slo_class": SLO_CLASS_INTERACTIVE, "preemptions": 1}},
+        {"req_id": "r", "name": "lease", "t": t0 + 0.04, "attrs": {}},
+        {"req_id": "r", "name": "admit", "t": t0 + 0.05, "attrs": {}},
+        {"req_id": "r", "name": "respond", "t": t0 + 0.06,
+         "attrs": {"ok": True, "n_tokens": 4}},
+    ]
+    cost = trace.request_cost(events, assume_sorted=True)
+    assert cost["slo_class"] == SLO_CLASS_INTERACTIVE
+    assert cost["preemptions"] == 1
+    # TTFT anchors to the FIRST admit — preemption doesn't reset it.
+    assert round(cost["ttft_s"], 3) == 0.02
+
+
+def test_observe_request_cost_feeds_per_class_slo():
+    reg = metrics_mod.SeriesRegistry(proc="t-priority")
+    metrics_mod.observe_request_cost({
+        "ok": True, "total_s": 0.3, "ttft_s": 0.1, "tokens": 4,
+        "preemptions": 2, "slo_class": SLO_CLASS_INTERACTIVE,
+    }, registry=reg)
+    metrics_mod.observe_request_cost({
+        "ok": True, "total_s": 2.0, "ttft_s": 1.8, "tokens": 4,
+        "preemptions": 0, "slo_class": SLO_CLASS_BATCH,
+    }, registry=reg)
+    names = reg.names()
+    assert "ttft_s_interactive" in names and "ttft_s_batch" in names
+    assert "preemptions_total" in names
+    assert reg.counter("preemptions_total").total == 2.0
+
+    out = metrics_mod.evaluate_slos([reg.export()])
+    rows = {r["name"]: r for r in out["objectives"]}
+    inter = rows["ttft_p95_500ms_interactive"]["windows"]["5m"]
+    assert inter["count"] == 1 and inter["attainment"] == 1.0
+    # The batch request's slow TTFT burns only the batch objective.
+    assert rows["ttft_p95_2s_standard"]["windows"]["5m"]["count"] == 0
+    assert interactive_burn(out) == 0.0
+
+
+def test_workload_export_carries_slo_class():
+    b = InProcBroker()
+    b.push_request(GenerateRequest(
+        id="wa", token_ids=[1, 2], max_new_tokens=3,
+        slo_class=SLO_CLASS_INTERACTIVE,
+    ))
+    b.pop_request()
+    b.push_response(GenerateResponse(id="wa", token_ids=[5, 6, 7]))
+    wl = trace.export_workload([trace.recorder().export()])
+    rows = {r["req_id"]: r for r in wl["requests"]}
+    assert rows["wa"]["slo_class"] == SLO_CLASS_INTERACTIVE
+
+    # replay restores the class onto the synthesized request
+    import importlib
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    tw = importlib.import_module("tools.trace_workload")
+    req = tw.synthesize_request(rows["wa"])
+    assert req.slo_class == SLO_CLASS_INTERACTIVE
+    # legacy "priority" key still restores the class
+    req = tw.synthesize_request({
+        "req_id": "x", "prompt_len": 2, "max_new_tokens": 2,
+        "priority": SLO_CLASS_BATCH,
+    })
+    assert req.slo_class == SLO_CLASS_BATCH

@@ -226,7 +226,10 @@ def test_burn_rates_match_hand_computed():
     assert out["windows"] == {"5m": 300.0, "1h": 3600.0}
     rows = {r["name"]: r for r in out["objectives"]}
     assert set(rows) == {"ttft_p95_500ms", "e2e_p95_5s",
-                         "terminal_error_rate"}
+                         "terminal_error_rate",
+                         "ttft_p95_500ms_interactive",
+                         "ttft_p95_2s_standard",
+                         "ttft_p95_15s_batch"}
 
     ttft = rows["ttft_p95_500ms"]
     for w in ("5m", "1h"):

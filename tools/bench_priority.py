@@ -1,0 +1,510 @@
+"""Priority bench: FIFO vs tiered+preemption vs tiered+brownout.
+
+Replays two heavy-tailed traces (the ROADMAP mixed-tenant scenario: a
+steady drip of long batch jobs, moderate standard traffic, interactive
+arrivals in tight bursts) through three scheduling arms of a
+deterministic virtual-time simulator of one continuous-batching worker,
+and reports per-class TTFT p95/p99, SLO attainment, and a
+chips-equivalent figure. The **burst** trace is recoverable overload —
+the FIFO-vs-tiered p95 headline, where a quiet brownout controller is
+itself the asserted behaviour. The **overload** trace is sustained
+demand beyond capacity, where priorities alone cannot save interactive
+and the degradation-ordering claims (batch before standard before
+interactive, interactive never shed) are asserted on real shed counts.
+
+The arms:
+
+- ``fifo``     — one class-blind queue, no preemption, admit-all. The
+  static-fleet baseline: interactive bursts queue behind batch rows.
+- ``tiered``   — class-priority queues + paged-KV preemption: an
+  interactive arrival blocked on row capacity evicts the lowest-class
+  running row (scheduler ``_maybe_preempt`` semantics: victim strictly
+  outranked, fewest emitted tokens; refund to the head of its class
+  queue; resume replays the emitted prefix).
+- ``brownout`` — tiered plus the real ``BrownoutController`` driven by
+  the interactive burn rate over the sim's sliding TTFT window, walking
+  the cap-batch -> shed-batch -> shed-standard ladder.
+
+The simulator advances in decode-chunk ticks (every resident row emits
+one token per tick); admission charges prompt prefill before the first
+token, and a resumed row re-charges prefill over prompt+emitted — the
+same cost shape the scheduler's chunked-replay resume pays. Virtual
+time makes the bench exactly reproducible: no sleeps, no wall-clock.
+
+``chips_equivalent`` is the static-fleet cost of buying the same
+interactive TTFT p95 without priorities: the smallest N such that the
+arm meets the interactive target with every rate scaled N× (N
+data-parallel replicas). FIFO needs several chips; the tiered arms hit
+the target on one — that delta is the PR's capacity claim.
+
+Also times the scheduler's real ``_maybe_preempt`` no-op paths (idle,
+and pending-but-not-blocked) on a live ContinuousBatcher — the per-step
+host tax every deployment with ``preempt_cb`` set pays — against the
+25 µs budget. Writes PRIORITY_BENCH.json with ``bench_provenance``;
+exits nonzero if any acceptance assertion fails.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import random
+import sys
+import time
+from collections import deque
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import bench_provenance  # noqa: E402
+from llmss_tpu.serve.fleet import BrownoutController  # noqa: E402
+from llmss_tpu.serve.protocol import (  # noqa: E402
+    SLO_CLASS_BATCH,
+    SLO_CLASS_INTERACTIVE,
+    SLO_CLASS_STANDARD,
+    SLO_CLASS_RANK,
+)
+
+SEED = 1405
+ROWS = 12
+STEP_S = 0.02  # one decode chunk: every resident row advances one token
+#: Admission and eviction happen at scheduler-step (group) boundaries —
+#: the group_chunks saturation configuration. One eviction per group is
+#: the _maybe_preempt bound; this serialization is exactly the latency
+#: brownout sidesteps by keeping rows free BEFORE the burst lands.
+GROUP_TICKS = 4
+PREFILL_TOKEN_S = 0.0004
+TRACE_S = 120.0
+BURN_WINDOW_S = 20.0
+
+#: per-class TTFT targets (ms) at p95 — mirrors DEFAULT_SLO_OBJECTIVES.
+TTFT_TARGET_MS = {
+    SLO_CLASS_INTERACTIVE: 500.0,
+    SLO_CLASS_STANDARD: 2000.0,
+    SLO_CLASS_BATCH: 15000.0,
+}
+SLO_TARGET = 0.95
+US_PER_CALL_BUDGET = 25.0
+MAX_CHIPS = 12
+
+CLASSES = (SLO_CLASS_INTERACTIVE, SLO_CLASS_STANDARD, SLO_CLASS_BATCH)
+
+
+def build_trace(overload: bool = False) -> list[dict]:
+    """A heavy-tailed bursty arrival trace, identical across arms.
+
+    Batch max_new is Pareto(a=1.1) — a long tail of multi-hundred-token
+    jobs that pin rows for seconds. Interactive arrives as tight bursts
+    on top of a steady drip; during a burst the offered row demand far
+    exceeds ROWS, which is the moment the arms diverge.
+
+    The default shape is bursty-but-recoverable: overload comes in
+    spikes the fleet can absorb between bursts (the FIFO-vs-tiered p95
+    headline). ``overload=True`` triples the background classes and
+    doubles the burst cadence — sustained demand beyond capacity where
+    priorities alone cannot save interactive and the brownout ladder
+    must shed (the degradation-ordering scenario).
+    """
+    rng = random.Random(SEED)
+    reqs = []
+    batch_rate = 7.5 if overload else 2.5
+    std_rate = 12.0 if overload else 4.0
+    burst_n, burst_gap = (24, 4) if overload else (16, 8)
+
+    t = 0.0
+    while t < TRACE_S:  # batch drip: long, heavy-tailed
+        t += rng.expovariate(batch_rate)
+        reqs.append({
+            "cls": SLO_CLASS_BATCH, "arrival": t, "plen": 256,
+            "max_new": min(512, int(24 * rng.paretovariate(1.1))),
+        })
+    t = 0.0
+    while t < TRACE_S:  # standard background
+        t += rng.expovariate(std_rate)
+        reqs.append({
+            "cls": SLO_CLASS_STANDARD, "arrival": t, "plen": 64,
+            "max_new": 8 + int(rng.expovariate(1 / 24)),
+        })
+    t = 0.0
+    while t < TRACE_S:  # interactive: drip + tight bursts
+        t += rng.expovariate(1.2)
+        reqs.append({
+            "cls": SLO_CLASS_INTERACTIVE, "arrival": t, "plen": 24,
+            "max_new": 4 + int(rng.expovariate(1 / 6)),
+        })
+    for burst0 in range(4, int(TRACE_S), burst_gap):
+        for _ in range(burst_n):
+            reqs.append({
+                "cls": SLO_CLASS_INTERACTIVE,
+                "arrival": burst0 + rng.random() * 0.4,
+                "plen": 24, "max_new": 4 + int(rng.expovariate(1 / 6)),
+            })
+    reqs.sort(key=lambda r: r["arrival"])
+    for i, r in enumerate(reqs):
+        r["id"] = i
+    return reqs
+
+
+class _Row:
+    __slots__ = ("req", "first_ready", "emitted")
+
+    def __init__(self, req, now, pf_s):
+        self.req = req
+        # prefill (prompt + any replayed resume tokens) completes before
+        # the first new token — a resumed row re-pays the replay.
+        self.first_ready = (
+            now + (req["plen"] + req.get("emitted", 0)) * pf_s
+        )
+        self.emitted = req.get("emitted", 0)
+
+
+def simulate(arm: str, trace: list[dict], speed: float = 1.0) -> dict:
+    """Run one arm over the trace at ``speed``× service rate (N chips
+    data-parallel); returns per-class latency/attainment stats."""
+    step_s = STEP_S / speed
+    pf_s = PREFILL_TOKEN_S / speed
+    queues = {c: deque() for c in CLASSES}
+    fifo_q: deque = deque()
+    active: list[_Row] = []
+    ttft: dict[str, list[float]] = {c: [] for c in CLASSES}
+    e2e: dict[str, list[float]] = {c: [] for c in CLASSES}
+    shed = {c: 0 for c in CLASSES}
+    offered = {c: 0 for c in CLASSES}
+    preemptions = 0
+    busy_s = 0.0
+    burn_samples: deque = deque()  # (t, ttft_s) for interactive finishes
+
+    ctrl = None
+    if arm == "brownout":
+        def read_burn():
+            if not burn_samples:
+                return 0.0
+            ok = sum(
+                1 for _, v in burn_samples
+                if v * 1e3 <= TTFT_TARGET_MS[SLO_CLASS_INTERACTIVE]
+            )
+            att = ok / len(burn_samples)
+            return (1.0 - att) / (1.0 - SLO_TARGET)
+
+        ctrl = BrownoutController(
+            read_burn, high=2.0, low=1.0, dwell_s=4.0, check_s=0.5,
+        )
+
+    def tick_ctrl(now):
+        # Drive the ladder on virtual time, then gate the real-time tick
+        # inside any later admit() so the rung stays the virtual one.
+        ctrl._next_check = 0.0
+        ctrl.tick(now=now)
+        ctrl._next_check = float("inf")
+
+    def pop_next():
+        if arm == "fifo":
+            return fifo_q.popleft() if fifo_q else None
+        for c in CLASSES:
+            if queues[c]:
+                return queues[c].popleft()
+        return None
+
+    def peek_rank():
+        if arm == "fifo":
+            return None
+        for c in CLASSES:
+            if queues[c]:
+                return SLO_CLASS_RANK[c]
+        return None
+
+    pending = deque(trace)
+    t = 0.0
+    k = 0  # tick counter: every GROUP_TICKS-th tick is a group boundary
+    while pending or fifo_q or any(queues.values()) or active:
+        t += step_s
+        k += 1
+        boundary = k % GROUP_TICKS == 0
+        if ctrl is not None:
+            tick_ctrl(t)
+        # arrivals
+        while pending and pending[0]["arrival"] <= t:
+            req = dict(pending.popleft())
+            offered[req["cls"]] += 1
+            if ctrl is not None:
+                # the real admission ladder, on a protocol-shaped stub
+                shim = _AdmitShim(req["cls"], req["max_new"])
+                ok, _retry = ctrl.admit(shim)
+                if not ok:
+                    shed[req["cls"]] += 1
+                    continue
+                req["max_new"] = shim.max_new_tokens
+            (fifo_q if arm == "fifo" else queues[req["cls"]]).append(req)
+        # preemption — group boundaries only, ONE eviction per boundary
+        # (the scheduler's _maybe_preempt bound): head-of-queue strictly
+        # outranks a running row and admission is blocked on rows
+        if boundary and arm != "fifo" and len(active) >= ROWS:
+            head_rank = peek_rank()
+            if head_rank is not None:
+                victim = None
+                for row in active:
+                    r_rank = SLO_CLASS_RANK[row.req["cls"]]
+                    if r_rank <= head_rank or row.emitted == 0:
+                        continue
+                    if victim is None or (
+                        (r_rank, -row.emitted)
+                        > (SLO_CLASS_RANK[victim.req["cls"]],
+                           -victim.emitted)
+                    ):
+                        victim = row
+                if victim is not None:
+                    active.remove(victim)
+                    req = victim.req
+                    req["emitted"] = victim.emitted  # resume point
+                    queues[req["cls"]].appendleft(req)  # head-of-class
+                    preemptions += 1
+        # admission into free rows — also quantized to group boundaries
+        # (rows freed mid-group wait for the next step, like the real
+        # one-group-lag decode loop)
+        while boundary and len(active) < ROWS:
+            req = pop_next()
+            if req is None:
+                break
+            active.append(_Row(req, t, pf_s))
+        # one decode chunk
+        if active:
+            busy_s += step_s
+        for row in list(active):
+            if row.first_ready > t:
+                continue
+            if row.emitted == 0 and "ttft" not in row.req:
+                # resumed rows keep their original first-admission TTFT
+                row.req["ttft"] = t - row.req["arrival"]
+                ttft[row.req["cls"]].append(row.req["ttft"])
+                if row.req["cls"] == SLO_CLASS_INTERACTIVE:
+                    burn_samples.append((t, row.req["ttft"]))
+            row.emitted += 1
+            if row.emitted >= row.req["max_new"]:
+                active.remove(row)
+                e2e[row.req["cls"]].append(t - row.req["arrival"])
+        while burn_samples and burn_samples[0][0] < t - BURN_WINDOW_S:
+            burn_samples.popleft()
+
+    out = {"classes": {}, "preemptions": preemptions,
+           "chip_busy_s": round(busy_s, 1)}
+    for c in CLASSES:
+        tgt = TTFT_TARGET_MS[c]
+        vals = ttft[c]
+        within = sum(1 for v in vals if v * 1e3 <= tgt)
+        out["classes"][c] = {
+            "offered": offered[c],
+            "completed": len(e2e[c]),
+            "shed": shed[c],
+            "ttft_p50_ms": _pct(vals, 0.50),
+            "ttft_p95_ms": _pct(vals, 0.95),
+            "ttft_p99_ms": _pct(vals, 0.99),
+            "ttft_target_ms": tgt,
+            # attainment over OFFERED traffic: a shed request is a
+            # degraded request — brownout can't launder its sheds out of
+            # the denominator.
+            "slo_attainment": round(within / offered[c], 4)
+            if offered[c] else None,
+        }
+    if ctrl is not None:
+        out["brownout"] = ctrl.state()
+    return out
+
+
+class _AdmitShim:
+    """Just enough of GenerateRequest for BrownoutController.admit."""
+
+    __slots__ = ("slo_class", "max_new_tokens")
+
+    def __init__(self, cls, max_new):
+        self.slo_class = cls
+        self.max_new_tokens = max_new
+
+
+def _pct(vals, q) -> float | None:
+    if not vals:
+        return None
+    s = sorted(vals)
+    i = min(len(s) - 1, math.ceil(q * len(s)) - 1)
+    return round(s[i] * 1e3, 1)
+
+
+def chips_equivalent(arm: str, trace: list[dict]) -> int | None:
+    """Smallest static N-chip fleet at which ``arm`` meets the
+    interactive TTFT p95 target; None if > MAX_CHIPS."""
+    tgt = TTFT_TARGET_MS[SLO_CLASS_INTERACTIVE]
+    for n in range(1, MAX_CHIPS + 1):
+        r = simulate(arm, trace, speed=float(n))
+        p95 = r["classes"][SLO_CLASS_INTERACTIVE]["ttft_p95_ms"]
+        if p95 is not None and p95 <= tgt:
+            return n
+    return None
+
+
+def preempt_hook_microbench() -> dict:
+    """Host cost of the scheduler's real ``_maybe_preempt`` no-op paths
+    on a live ContinuousBatcher: idle (no pending), and the steady-state
+    pending-but-unblocked check. These run once per step in every
+    deployment that sets ``preempt_cb``."""
+    import jax
+
+    from llmss_tpu.engine import DecodeEngine
+    from llmss_tpu.engine.scheduler import ContinuousBatcher
+    from llmss_tpu.models.common import DecoderConfig
+    from llmss_tpu.models.decoder import init_params
+    from llmss_tpu.parallel import MeshPlan, make_mesh
+
+    cfg = DecoderConfig(
+        model_type="llama", vocab_size=64, hidden_size=32, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=8, intermediate_size=64,
+        max_position_embeddings=64, activation="silu", norm="rmsnorm",
+        norm_eps=1e-5, mlp="swiglu", positions="rotary", rope_style="half",
+        rotary_dim=8, attn_bias=False, mlp_bias=False,
+        tie_word_embeddings=False, dtype="float32",
+    )
+    mesh = make_mesh(MeshPlan(dp=1, tp=len(jax.devices())))
+    params = init_params(cfg, mesh, jax.random.key(0))
+    engine = DecodeEngine(cfg, params, mesh, max_seq_len=64)
+    bat = ContinuousBatcher(engine, rows=4)
+    bat.preempt_cb = lambda rid, toks: None
+
+    n = 20000
+    best_idle = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            bat._maybe_preempt()
+        best_idle = min(best_idle, (time.perf_counter() - t0) / n)
+
+    # steady-state: a pending head exists but free rows remain, so the
+    # hook reads the head's priority and returns without scanning rows
+    # (only index 7 — priority — is touched on this path).
+    fake = (None, None, None, None, None, None, None, 1, 0)
+    with bat._lock:
+        bat.pending.append(fake)
+    best_pending = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            bat._maybe_preempt()
+        best_pending = min(best_pending, (time.perf_counter() - t0) / n)
+    with bat._lock:
+        bat.pending.clear()
+    return {
+        "idle_us": round(best_idle * 1e6, 3),
+        "pending_unblocked_us": round(best_pending * 1e6, 3),
+        "budget_us": US_PER_CALL_BUDGET,
+    }
+
+
+def main() -> int:
+    # Scenario 1 — bursty-but-recoverable: the p95 headline. Tiered
+    # scheduling absorbs what FIFO cannot; brownout stays on the ladder's
+    # bottom rung (nothing needs shedding — that is itself a property
+    # worth pinning: the controller is quiet when capacity suffices).
+    burst_trace = build_trace()
+    burst = {}
+    for arm in ("fifo", "tiered", "brownout"):
+        burst[arm] = simulate(arm, burst_trace)
+        burst[arm]["chips_equivalent"] = chips_equivalent(arm, burst_trace)
+    # Scenario 2 — sustained overload: demand exceeds capacity for the
+    # whole trace, priorities alone cannot protect interactive, and the
+    # ladder must walk. Degradation ordering is asserted HERE, on real
+    # shed counts, never on a trace where nothing degrades.
+    over_trace = build_trace(overload=True)
+    over = {arm: simulate(arm, over_trace)
+            for arm in ("fifo", "tiered", "brownout")}
+    micro = preempt_hook_microbench()
+
+    fifo_i = burst["fifo"]["classes"][SLO_CLASS_INTERACTIVE]
+    bo_i = burst["brownout"]["classes"][SLO_CLASS_INTERACTIVE]
+    obo = over["brownout"]["classes"]
+
+    def degradation(c):
+        # 1 - attainment over OFFERED traffic (sheds count against the
+        # class): the "how much did this class hurt" score the ladder
+        # ordering is judged by.
+        return 1.0 - (obo[c]["slo_attainment"] or 0.0)
+
+    def att(arms, arm):
+        a = arms[arm]["classes"][SLO_CLASS_INTERACTIVE]["slo_attainment"]
+        return a or 0.0
+
+    checks = {
+        # the headline: brownout meets the interactive target that FIFO
+        # blows through on the same single chip
+        "brownout_interactive_p95_meets_target":
+            bo_i["ttft_p95_ms"] <= TTFT_TARGET_MS[SLO_CLASS_INTERACTIVE],
+        "fifo_interactive_p95_violates":
+            fifo_i["ttft_p95_ms"] > TTFT_TARGET_MS[SLO_CLASS_INTERACTIVE],
+        "preemption_engaged": burst["tiered"]["preemptions"] > 0,
+        # overload: the ladder actually walked — batch was shed and the
+        # controller recorded transitions (not a vacuous pass)
+        "brownout_engaged":
+            obo[SLO_CLASS_BATCH]["shed"] > 0
+            and over["brownout"]["brownout"]["transitions_total"] > 0,
+        # degradation is ordered: batch before standard before
+        # interactive, and interactive is never shed in ANY scenario
+        "degradation_order_batch_standard_interactive":
+            degradation(SLO_CLASS_BATCH)
+            >= degradation(SLO_CLASS_STANDARD)
+            >= degradation(SLO_CLASS_INTERACTIVE),
+        "standard_sheds_only_after_batch":
+            obo[SLO_CLASS_STANDARD]["shed"] == 0
+            or obo[SLO_CLASS_BATCH]["shed"] > 0,
+        "interactive_never_shed": all(
+            arms[a]["classes"][SLO_CLASS_INTERACTIVE]["shed"] == 0
+            for arms in (burst, over) for a in arms
+        ),
+        # under overload, shedding buys interactive more attainment than
+        # either priorities alone or FIFO
+        "brownout_protects_interactive_under_overload":
+            att(over, "brownout") >= att(over, "tiered")
+            and att(over, "brownout") > att(over, "fifo"),
+        "preempt_hook_within_budget":
+            max(micro["idle_us"], micro["pending_unblocked_us"])
+            <= US_PER_CALL_BUDGET,
+    }
+
+    out = {
+        "bench": "priority_scheduling",
+        "provenance": bench_provenance(),
+        "config": {
+            "seed": SEED, "rows": ROWS, "step_s": STEP_S,
+            "group_ticks": GROUP_TICKS,
+            "prefill_token_s": PREFILL_TOKEN_S, "trace_s": TRACE_S,
+            "n_requests_burst": len(burst_trace),
+            "n_requests_overload": len(over_trace),
+            "ttft_targets_ms": TTFT_TARGET_MS, "slo_target": SLO_TARGET,
+        },
+        "scenarios": {"burst": burst, "overload": over},
+        "preempt_hook": micro,
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PRIORITY_BENCH.json",
+    )
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps({
+        "metric": "interactive_ttft_p95_ms",
+        "value": bo_i["ttft_p95_ms"],
+        "unit": (
+            f"ms on 1 chip under brownout (fifo={fifo_i['ttft_p95_ms']} ms; "
+            f"chips-equivalent fifo={burst['fifo']['chips_equivalent']} vs "
+            f"brownout={burst['brownout']['chips_equivalent']}; "
+            f"{burst['tiered']['preemptions']} preemptions in burst arm; "
+            f"overload sheds batch={obo[SLO_CLASS_BATCH]['shed']} "
+            f"standard={obo[SLO_CLASS_STANDARD]['shed']} interactive=0; "
+            f"preempt hook {micro['pending_unblocked_us']} us)"
+        ),
+        "ok": out["ok"],
+        "failed_checks": [k for k, v in checks.items() if not v],
+    }))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

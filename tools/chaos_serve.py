@@ -44,7 +44,10 @@ from llmss_tpu.serve.chaos import (  # noqa: E402
 )
 from llmss_tpu.serve.consumer import Worker  # noqa: E402
 from llmss_tpu.serve.handoff import DecodeWorker, PrefillWorker  # noqa: E402
-from llmss_tpu.serve.protocol import GenerateRequest  # noqa: E402
+from llmss_tpu.serve.protocol import (  # noqa: E402
+    SLO_CLASSES,
+    GenerateRequest,
+)
 from llmss_tpu.serve.supervisor import Supervisor  # noqa: E402
 
 
@@ -343,6 +346,164 @@ def run_kill_mid_handoff(args):
     return 1 if violations else 0
 
 
+class _PreemptThenDie:
+    """Chaos worker for ``--fault burst``: leases a request, records its
+    partial progress as ``resume_tokens``, hands it back through the
+    preemption refund path, then hard-kills — the
+    preempted-but-not-yet-resumed window. Alternate kills die while
+    still *holding* the lease (no preempt), so the reaper redelivery
+    window is exercised in the same run. Once its kill budget is spent
+    it idles and the healthy worker drains the queue."""
+
+    def __init__(self, broker, kills_left, klock, partial=2):
+        self.broker = broker
+        self.kills_left = kills_left
+        self.klock = klock
+        self.partial = partial
+
+    def run_once(self):
+        with self.klock:
+            if self.kills_left[0] <= 0:
+                time.sleep(0.05)
+                return
+            req = self.broker.pop_request(timeout=0.02)
+            if req is None:
+                return
+            n = self.kills_left[0]
+            self.kills_left[0] = n - 1
+        if n % 2:
+            full = ScriptedEngine.expected_tokens(
+                list(req.token_ids), req.max_new_tokens
+            )
+            take = min(
+                len(req.resume_tokens or ()) + self.partial,
+                req.max_new_tokens - 1,
+            )
+            req.resume_tokens = full[:take] or None
+            req.preemptions += 1
+            self.broker.preempt_requests([req])
+            raise HardKill(f"chaos: died after preempting {req.id}")
+        raise HardKill(f"chaos: died holding lease on {req.id}")
+
+
+def run_burst(args):
+    """Mixed-class burst chaos (``--fault burst``).
+
+    The whole request set — interactive, standard, and batch interleaved
+    — lands on the queue at once. One chaos replica preempts requests
+    mid-flight (stamping partial ``resume_tokens``) and dies in the
+    preempted-but-not-yet-resumed window, or dies holding an unpreempted
+    lease; one healthy replica serves everything, resuming preempted
+    work from its replayed tokens. The audit fails the process unless
+    every request got exactly one terminal response whose token stream
+    equals the never-preempted scripted stream.
+    """
+    args.workers = 2
+    prod_broker, (doom_b, work_b) = build_brokers(args)
+
+    kills_left = [args.kills]
+    klock = threading.Lock()
+    doom_host = ChaosWorkerHost(
+        lambda: _PreemptThenDie(doom_b, kills_left, klock),
+        respawn_delay_s=0.02,
+    )
+    work_host = ChaosWorkerHost(
+        lambda: Worker(
+            ScriptedEngine(), work_b, batch_size=args.batch_size,
+            poll_timeout_s=0.02, pad_batch=False,
+        ),
+        respawn_delay_s=0.02,
+    )
+
+    reqs = [
+        GenerateRequest(
+            token_ids=[i % 1000 + 1, i % 7 + 1], max_new_tokens=4,
+            slo_class=SLO_CLASSES[i % len(SLO_CLASSES)],
+            deadline_ts=time.time() + args.deadline_s,
+        )
+        for i in range(args.requests)
+    ]
+    for r in reqs:
+        prod_broker.push_request(r)
+    doom_host.start()
+    # The chaos replica must get its kills in before the healthy replica
+    # starts draining, or a fast worker races it to an empty queue.
+    spend_deadline = time.time() + args.deadline_s / 2
+    while time.time() < spend_deadline:
+        with klock:
+            if kills_left[0] <= 0:
+                break
+        time.sleep(0.01)
+    work_host.start()
+
+    results: dict[str, object] = {}
+    lock = threading.Lock()
+
+    def wait_one(req):
+        resp = prod_broker.wait_response(req.id, timeout=args.deadline_s)
+        with lock:
+            results[req.id] = resp
+        dup = prod_broker.wait_response(req.id, timeout=0.2)
+        if dup is not None:
+            with lock:
+                results[req.id] = "DUPLICATE"
+
+    waiters = [
+        threading.Thread(target=wait_one, args=(r,), daemon=True)
+        for r in reqs
+    ]
+    for t in waiters:
+        t.start()
+    for t in waiters:
+        t.join(timeout=args.deadline_s + 5)
+    doom_host.stop()
+    work_host.stop()
+
+    lost, dup, wrong, ok, errored = [], [], [], 0, 0
+    for r in reqs:
+        got = results.get(r.id)
+        if got is None:
+            lost.append(r.id)
+        elif got == "DUPLICATE":
+            dup.append(r.id)
+        elif got.error:
+            errored += 1
+        elif got.token_ids != ScriptedEngine.expected_tokens(
+            list(r.token_ids), r.max_new_tokens
+        ):
+            wrong.append(r.id)
+        else:
+            ok += 1
+
+    stats = prod_broker.delivery_stats()
+    report = {
+        "fault": "burst",
+        "requests": args.requests,
+        "ok": ok,
+        "errored": errored,
+        "lost": len(lost),
+        "duplicates": len(dup),
+        "wrong_payload": len(wrong),
+        "chaos_kills": doom_host.kills,
+        "preempted": stats.get("preempted"),
+        "dlq_depth": prod_broker.dlq_depth(),
+        "delivery": stats,
+        "host_errors": [
+            h.error for h in (doom_host, work_host) if h.error
+        ],
+    }
+    print(json.dumps(report))
+    violations = bool(
+        lost or dup or wrong or errored or report["host_errors"]
+    )
+    violations |= doom_host.kills < args.kills  # every kill must fire
+    # Preempt-then-die kills must all have traveled the refund path, and
+    # a refunded preemption must never land in the DLQ.
+    violations |= (stats.get("preempted") or 0) < -(-args.kills // 2)
+    violations |= prod_broker.dlq_depth() > 0
+    return 1 if violations else 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         "chaos_serve", description=__doc__.split("\n")[0]
@@ -365,7 +526,8 @@ def main(argv=None):
                    help="end-to-end deadline stamped on every request")
     p.add_argument("--batch-size", type=int, default=1)
     p.add_argument("--fault",
-                   choices=("drain", "hang", "nan", "kill-mid-handoff"),
+                   choices=("drain", "hang", "nan", "kill-mid-handoff",
+                            "burst"),
                    default=None,
                    help="run a deterministic scripted-failure scenario "
                         "instead of the random kill/drop fleet")
@@ -376,6 +538,8 @@ def main(argv=None):
 
     if args.fault == "kill-mid-handoff":
         return run_kill_mid_handoff(args)
+    if args.fault == "burst":
+        return run_burst(args)
     if args.fault is not None:
         return run_fault(args)
 

@@ -6,7 +6,8 @@ tokens it asked for, and which requests shared a cached prefix. The
 producer's ``GET /trace/export_workload`` distils that into a compact
 ``llmss-workload/1`` JSON (see ``trace.export_workload``): arrival
 offsets from the first request, prompt/max_new lengths, prefix hashes,
-and a ``priority`` slot reserved for a future scheduler class.
+and each arrival's ``slo_class`` so a replay reproduces the priority
+mix the SLO-tiered scheduler saw.
 
 This tool does two jobs:
 
@@ -43,7 +44,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from llmss_tpu.serve.protocol import GenerateRequest  # noqa: E402
+from llmss_tpu.serve.protocol import (  # noqa: E402
+    SLO_CLASSES,
+    GenerateRequest,
+)
 from llmss_tpu.utils import trace  # noqa: E402
 
 #: Synthesized shared-prefix length. The workload records prefix
@@ -94,6 +98,11 @@ def synthesize_request(
         token_ids=[(index * 7 + j) % VOCAB for j in range(plen)],
         max_new_tokens=int(row.get("max_new_tokens") or 20),
     )
+    # Older captures carried a "priority" placeholder instead; either key
+    # restores the scheduling class, defaulting to standard.
+    cls = row.get("slo_class") or row.get("priority")
+    if cls in SLO_CLASSES:
+        req.slo_class = cls
     ph = row.get("prefix_hash")
     if ph is not None:
         if prefixes is None:
