@@ -509,6 +509,19 @@ class BlockAllocator:
         with self._lock:
             return self._refs.get(block, 0)
 
+    def largest_free_run(self) -> int:
+        """Longest contiguous run of free block ids — the fragmentation
+        signal for the devtel counter tracks (== free_blocks means the
+        pool is unfragmented). O(free) sort+scan; callers throttle."""
+        with self._lock:
+            ids = sorted(self._free_list)
+        best = cur = 1 if ids else 0
+        for a, b in zip(ids, ids[1:]):
+            cur = cur + 1 if b == a + 1 else 1
+            if cur > best:
+                best = cur
+        return best
+
     def record_evictions(self, n: int) -> None:
         with self._lock:
             self.evictions += n

@@ -38,6 +38,7 @@ from llmss_tpu.engine.cache import (
 )
 from llmss_tpu.models.common import DecoderConfig
 from llmss_tpu.ops.sampling import sample
+from llmss_tpu.utils import devtel
 
 if TYPE_CHECKING:  # a runtime import would be circular when the models
     # package is imported first (models.decoder -> engine.cache runs
@@ -185,6 +186,7 @@ class DecodeEngine:
         self.metrics = EngineMetrics()
         self._ladder = self.bucket_ladder()
         self._canon_cache_memo: dict[tuple, KVCache | PagedKVCache] = {}
+        self._devtel_model: devtel.EngineCostModel | None = None
 
         # mesh is partial-bound (a compile-time constant, not a traced arg):
         # it enables the shard_map'd Pallas attention path inside forward.
@@ -725,6 +727,41 @@ class DecodeEngine:
                 f"({self.max_seq_len})"
             )
 
+    def devtel_cost_model(self) -> devtel.EngineCostModel:
+        """Lazy analytical roofline model for this engine's config — the
+        fallback cost source when the backend's cost_analysis is empty
+        and the lazy source for signatures first seen mid-serve."""
+        if self._devtel_model is None:
+            count, nbytes = devtel.param_stats(self.params)
+            self._devtel_model = devtel.EngineCostModel(
+                self.cfg, count, nbytes,
+                kv_itemsize=jnp.dtype(self._cache_dtype).itemsize,
+                max_seq_len=self.max_seq_len,
+            )
+        return self._devtel_model
+
+    def devtel_cost(
+        self, kind: str, key: tuple, *, batch: int, steps: int,
+        kv_len: int | None, prefill_tokens: int = 0, lower_thunk=None,
+    ) -> devtel.KernelCost | None:
+        """Cost for one executable signature via the process cost table:
+        cache hit (the per-dispatch path — one dict get), else
+        ``lower_thunk().cost_analysis()`` (prewarm passes the thunk), else
+        the analytical model. ``key`` must be identical between the
+        prewarm derivation and the fold-site lookup."""
+        full_key = (kind, *key)
+        hit = devtel.costs().get(full_key)
+        if hit is not None:
+            # The per-dispatch path: never price the analytical model on
+            # a hit — step_cost alone busts the 2 us/group budget
+            # (DEVTEL_BENCH.json).
+            return hit
+        m = self.devtel_cost_model()
+        return devtel.costs().derive(
+            full_key, lower_thunk,
+            fallback=m.step_cost(batch, steps, kv_len, prefill_tokens),
+        )
+
     def prewarm(
         self, batch: int, *, chunk_steps: tuple[int, ...] | int = (),
         buckets: bool = True, prefix_prefill: bool = False,
@@ -752,11 +789,26 @@ class DecodeEngine:
         if isinstance(chunk_steps, int):
             chunk_steps = (chunk_steps,)
         sa = self._sample_args(GenerationParams(), batch)
+        dt = devtel.enabled()
+        if dt:
+            devtel.install_monitoring_hook()
+            devtel.observer().watch_obj(self)
         n = 0
         for S in self.seq_buckets():
             cache = self.new_cache(batch)
             ids = jnp.zeros((batch, S), jnp.int32)
             lens = jnp.ones(batch, jnp.int32)
+            if dt:
+                # Derive roofline cost BEFORE the executing call: lower()
+                # only traces (nothing is donated), but after execution
+                # the donated cache buffer is gone.
+                self.devtel_cost(
+                    "prefill", (batch, S), batch=batch, steps=1, kv_len=S,
+                    prefill_tokens=batch * (S - 1),
+                    lower_thunk=lambda: self._prefill.lower(
+                        self.params, ids, cache, lens, sa
+                    ),
+                )
             tok, _, cache = self._prefill(self.params, ids, cache, lens, sa)
             del cache
             n += 1
@@ -773,6 +825,13 @@ class DecodeEngine:
         cache = self.canon_cache(self.new_cache(batch))
         cur = self.canon_vec(jnp.ones(batch, jnp.int32))
         for tb in bucket_set:
+            if dt:
+                self.devtel_cost(
+                    "decode", (batch, tb), batch=batch, steps=1, kv_len=tb,
+                    lower_thunk=lambda: self._decode.lower(
+                        self.params, tok, cache, cur, sa, t_bucket=tb
+                    ),
+                )
             _, _, c2 = self._decode(
                 self.params, tok, cache, cur, sa, t_bucket=tb
             )
@@ -787,6 +846,15 @@ class DecodeEngine:
                 # generate()'s chunked branch runs the grouped program at
                 # n_chunks=1 — token/position carries are donated, so
                 # rebind them from the outputs before the next compile.
+                if dt:
+                    self.devtel_cost(
+                        "decode_group", (batch, 1, k, tb),
+                        batch=batch, steps=k, kv_len=tb,
+                        lower_thunk=lambda: self._decode_group.lower(
+                            self.params, tok, cache, cur, sa, done, eos,
+                            n_chunks=1, n_steps=k, t_bucket=tb,
+                        ),
+                    )
                 _, t2, c2, cur2, _ = self._decode_group(
                     self.params, tok, cache, cur, sa, done, eos,
                     n_chunks=1, n_steps=k, t_bucket=tb,
@@ -1131,11 +1199,11 @@ class DecodeEngine:
                 flush_increments()
             else:
                 t0 = time.perf_counter()
+                tb = self.decode_bucket(pos_hi + k)
                 packed, last_tok, cache, cur_pos, _ = self._decode_group(
                     self.params, tok, cache, cur_pos, sample_args,
                     self.canon_vec(jnp.asarray(done)), eos_dev,
-                    n_chunks=1, n_steps=k,
-                    t_bucket=self.decode_bucket(pos_hi + k),
+                    n_chunks=1, n_steps=k, t_bucket=tb,
                 )
                 cache = self.canon_cache(cache)
                 cur_pos = self.canon_vec(cur_pos)
@@ -1151,9 +1219,19 @@ class DecodeEngine:
                 self.metrics.add_host_sync()
                 chunk_np = flat[: B * k].reshape(B, k)
                 poisoned_np = flat[B * k:].astype(bool)
-                self.metrics.decode_step.record(
-                    (time.perf_counter() - t0) / k
-                )
+                t1 = time.perf_counter()
+                self.metrics.decode_step.record((t1 - t0) / k)
+                if devtel.enabled():
+                    # Dispatch→fetch covers the whole fused group, so the
+                    # fold prices the full k-step executable (cache hit
+                    # after prewarm; analytical for cold signatures).
+                    devtel.fold(
+                        "decode_group", t1 - t0,
+                        self.devtel_cost(
+                            "decode_group", (B, 1, k, tb),
+                            batch=B, steps=k, kv_len=tb,
+                        ),
+                    )
                 t_cb = time.perf_counter()
                 for col in range(k):
                     if process(chunk_np[:, col]):

@@ -58,7 +58,7 @@ from llmss_tpu.engine.cache import (
     table_sentinel,
 )
 from llmss_tpu.engine.engine import DecodeEngine, GenerationParams, _bucket
-from llmss_tpu.utils import trace
+from llmss_tpu.utils import devtel, trace
 
 
 @dataclasses.dataclass
@@ -125,6 +125,11 @@ class _InFlightGroup:
     # _InFlightAdmission). Rows absent from the map either finished
     # streaming earlier or are still mid-prompt (skip their chunks).
     prefill_firsts: dict | None = None
+    # Devtel roofline tagging, attached at dispatch (a cost-table dict
+    # get) so _process_group can fold the measured fetch-to-fetch
+    # interval into achieved MFU/MBU without recomputing the key.
+    kind: str = "decode_group"
+    cost: object = None  # devtel.KernelCost | None
 
 
 class ContinuousBatcher:
@@ -279,6 +284,7 @@ class ContinuousBatcher:
         self._inflight: _InFlightGroup | None = None
         self._pending_adm: _InFlightAdmission | None = None
         self._last_fetch_t: float | None = None
+        self._devtel_last_t = float("-inf")
         self._lock = threading.Lock()
 
         cfg = engine.cfg
@@ -562,6 +568,13 @@ class ContinuousBatcher:
         eng = self.engine
         if seq_buckets is None:
             seq_buckets = eng.seq_buckets()
+        dt = devtel.enabled()
+        if dt:
+            devtel.install_monitoring_hook()
+            # Watch both jit namespaces: the engine's grouped/ragged
+            # programs AND the scheduler's own insert/prefill-row jits.
+            devtel.observer().watch_obj(eng)
+            devtel.observer().watch_obj(self)
         Ps, p = [], 1
         while p < self.rows:
             Ps.append(p)
@@ -661,6 +674,21 @@ class ContinuousBatcher:
         })
         for nc, k in combos:
             for tb in eng.prewarm_bucket_set():
+                if dt:
+                    # Roofline cost from the unoptimized HLO, derived
+                    # BEFORE the executing call (lower() only traces;
+                    # execution deletes the donated carries).
+                    eng.devtel_cost(
+                        "decode_group", (self.rows, nc, k, tb),
+                        batch=self.rows, steps=nc * k, kv_len=tb,
+                        lower_thunk=lambda: eng._decode_group.lower(
+                            eng.params, self._tokens_dev, self.cache,
+                            self._cur_pos_dev, sa,
+                            jnp.ones(self.rows, bool),
+                            jnp.full(self.rows, -1, np.int32),
+                            n_chunks=nc, n_steps=k, t_bucket=tb,
+                        ),
+                    )
                 _, last_tok, cache, cur_pos, _ = eng._decode_group(
                     eng.params, self._tokens_dev, self.cache,
                     self._cur_pos_dev, sa,
@@ -681,6 +709,25 @@ class ContinuousBatcher:
             for nc in sorted({
                 self.group_chunks * self.chunk_steps, self.chunk_steps_low,
             }):
+                if dt:
+                    # The padded ragged executable computes every chunk
+                    # slot regardless of masks, so its cost includes the
+                    # full nc·rows·CB prefill budget.
+                    eng.devtel_cost(
+                        "ragged_group", (self.rows, nc, CB),
+                        batch=self.rows, steps=nc, kv_len=None,
+                        prefill_tokens=nc * self.rows * CB,
+                        lower_thunk=lambda: eng._ragged_group.lower(
+                            eng.params, self._tokens_dev, self.cache,
+                            self._cur_pos_dev, sa,
+                            jnp.ones(self.rows, bool),
+                            jnp.full(self.rows, -1, np.int32),
+                            jnp.zeros((nc, self.rows, CB), jnp.int32),
+                            jnp.ones((nc, self.rows), jnp.int32),
+                            jnp.zeros((nc, self.rows), bool),
+                            jnp.ones((nc, self.rows), bool),
+                        ),
+                    )
                 _, last_tok, cache, cur_pos, _ = eng._ragged_group(
                     eng.params, self._tokens_dev, self.cache,
                     self._cur_pos_dev, sa,
@@ -715,6 +762,11 @@ class ContinuousBatcher:
         # the same guard).
         jax.block_until_ready(self.cache.positions)
         _ = int(jnp.zeros((), jnp.int32) + 1)
+        if dt:
+            # Every serving-path executable is compiled: from here on any
+            # compile is a steady-state recompile — counted by the
+            # observer and flagged on /slo.
+            devtel.observer().mark_steady()
         return n_compiled
 
     # -- submission ---------------------------------------------------------
@@ -1514,6 +1566,14 @@ class ContinuousBatcher:
             self.engine.metrics.decode_step.record(
                 (now - self._last_fetch_t) / (nc * k)
             )
+        if self._last_fetch_t is not None and group.cost is not None:
+            # Roofline fold: the same fetch-to-fetch interval against the
+            # executable's derived cost. Unlike decode_step, admission
+            # groups fold too (ragged groups ARE the admission path) —
+            # the included prefill/insert work slightly under-reports
+            # utilization for those samples, a documented caveat
+            # (docs/observability.md).
+            devtel.fold(group.kind, now - self._last_fetch_t, group.cost)
         self._last_fetch_t = now
 
         n = 0
@@ -1693,6 +1753,12 @@ class ContinuousBatcher:
             group = _InFlightGroup(
                 packed=packed, n_chunks=nc, k=k, has_admission=True,
                 prefill_firsts=firsts,
+                kind="ragged_group",
+                cost=self.engine.devtel_cost(
+                    "ragged_group", (self.rows, nc, self.chunked_prefill),
+                    batch=self.rows, steps=nc, kv_len=None,
+                    prefill_tokens=nc * self.rows * self.chunked_prefill,
+                ) if devtel.enabled() else None,
             )
         else:
             # Busy → the full group of full chunks (host off the critical
@@ -1723,6 +1789,10 @@ class ContinuousBatcher:
             group = _InFlightGroup(
                 packed=packed, n_chunks=nc, k=k,
                 has_admission=self._pending_adm is not None,
+                cost=self.engine.devtel_cost(
+                    "decode_group", (self.rows, nc, k, t_bucket),
+                    batch=self.rows, steps=nc * k, kv_len=t_bucket,
+                ) if devtel.enabled() else None,
             )
         self.cache = self.engine.canon_cache(cache)
         self._cur_pos_dev = self.engine.canon_vec(cur_pos)
@@ -1753,7 +1823,55 @@ class ContinuousBatcher:
         # overlaps the in-flight group and lands before the next one.
         self._pending_adm = self._admit_dispatch()
         self._step_count += 1
+        if devtel.enabled():
+            self._devtel_sample()
         return n
+
+    def _devtel_sample(self) -> None:
+        """Devtel sampling at a group boundary: counter tracks (throttled
+        to 0.05 s — the group_dispatch trace cadence) and the compile
+        observer's ``_cache_size`` sweep (throttled to 0.5 s inside the
+        observer). Host counters and host tables only — never a device
+        sync (``memory_stats`` reads runtime-owned host counters)."""
+        now = time.monotonic()
+        rid = next(
+            (r.req_id for r in self.active.values() if r.req_id), None,
+        )
+        devtel.observer().maybe_sample(rid)
+        if now - self._devtel_last_t < 0.05:
+            return
+        self._devtel_last_t = now
+        with self._lock:
+            pending = len(self.pending)
+            free_slots = len(self._free)
+        prefill_rows = len(self._inflight_prefill)
+        tracks = {
+            "rows": {
+                "decode": len(self.active) - prefill_rows,
+                "prefill": prefill_rows,
+                "free": free_slots,
+            },
+            "queue_depth": {"pending": pending},
+        }
+        if self._paged:
+            alloc = self.allocator
+            free = alloc.free_blocks
+            tracks["kv_blocks"] = {
+                "in_use": alloc.num_blocks - free, "free": free,
+            }
+            tracks["kv_fragmentation"] = {
+                "largest_free_run": alloc.largest_free_run(), "free": free,
+            }
+        util = devtel.last_util()
+        if util:
+            # The roofline gauges ride the counter tracks too, so the
+            # Perfetto timeline shows achieved MFU/MBU next to the spans.
+            tracks["mfu"] = {k: g["mfu"] for k, g in util.items()}
+            tracks["mbu"] = {k: g["mbu"] for k, g in util.items()}
+        mem = devtel.device_memory_stats()
+        if mem is not None:
+            tracks["device_memory"] = mem
+        devtel.record_counters(tracks, t=now)
 
     @property
     def idle(self) -> bool:

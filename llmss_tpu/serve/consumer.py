@@ -37,6 +37,7 @@ from llmss_tpu.serve.protocol import (
     GenerateResponse,
     prefix_hash,
 )
+from llmss_tpu.utils import devtel
 from llmss_tpu.utils import metrics as metrics_mod
 from llmss_tpu.utils import trace
 
@@ -170,6 +171,9 @@ class Worker:
                 {"series": metrics_mod.series().export(cache_s=1.0)}
                 if trace.enabled() else {}
             ),
+            # Device telemetry (roofline gauges, compile forensics,
+            # counter tracks) rides the same heartbeat.
+            **({"devtel": devtel.export()} if devtel.enabled() else {}),
         }
 
     def _publish_load(self) -> None:
@@ -534,7 +538,23 @@ class ContinuousWorker:
                 {"series": metrics_mod.series().export(cache_s=1.0)}
                 if trace.enabled() else {}
             ),
+            # Device telemetry blob (see Worker.load_snapshot).
+            **({"devtel": devtel.export()} if devtel.enabled() else {}),
         })
+        if devtel.enabled():
+            # Queue depths BY CLASS come from the broker, not the batcher
+            # — sampled here at heartbeat cadence so the counter track
+            # shows which class's queue a waiting request sat in.
+            depths = getattr(self.broker, "queue_depths_by_class", None)
+            if depths is not None:
+                try:
+                    by_class = {
+                        str(k): int(v) for k, v in depths().items()
+                    }
+                except Exception:  # noqa: BLE001 — telemetry never gates serving
+                    by_class = {}
+                if by_class:
+                    devtel.record_counters({"queue_by_class": by_class})
         return snap
 
     def _publish_load(self) -> None:

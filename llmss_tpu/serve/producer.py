@@ -24,6 +24,7 @@ from llmss_tpu.serve.protocol import (
     STATE_READY,
     GenerateRequest,
 )
+from llmss_tpu.utils import devtel
 from llmss_tpu.utils import metrics as metrics_mod
 from llmss_tpu.utils import trace
 from llmss_tpu.utils.metrics import profile_trace, render_prometheus
@@ -32,8 +33,16 @@ from llmss_tpu.utils.metrics import profile_trace, render_prometheus
 _PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 # jax.profiler keeps one global trace per process, so one in-flight
-# POST /profile per process is the correct serialization unit.
+# POST /profile per process is the correct serialization unit. The lock
+# guards the slot fields below; the slot itself expires at its deadline
+# (duration + grace) so a crashed caller can never wedge profiling until
+# restart — the next POST force-stops the orphaned profiler and takes
+# over.
 _PROFILE_LOCK = threading.Lock()
+_PROFILE_ACTIVE = 0  # generation of the in-flight profile, 0 when idle; guarded_by: _PROFILE_LOCK
+_PROFILE_GEN = 0  # guarded_by: _PROFILE_LOCK
+_PROFILE_DEADLINE = 0.0  # monotonic expiry of the active slot; guarded_by: _PROFILE_LOCK
+_PROFILE_GRACE_S = 5.0
 
 # Class-aware admission: the fraction of max_queue_depth each class may
 # fill before shedding. Batch saturates at half the backlog so a batch
@@ -114,17 +123,38 @@ def collect_series_exports(broker: Broker) -> tuple[list[dict], dict]:
     return exports, sources
 
 
+def collect_devtel_exports(broker: Broker) -> list[dict]:
+    """Every device-telemetry export visible from this producer: the
+    local process blob plus the per-worker blobs riding the registry
+    heartbeats (``load_snapshot`` embeds ``devtel``), deduped to one per
+    process (in-process fleets surface the same module singleton through
+    both paths)."""
+    exports: list[dict] = []
+    if devtel.enabled():
+        exports.append(devtel.export())
+    for _wid, info in sorted(broker.read_workers().items()):
+        blob = info.get("devtel")
+        if isinstance(blob, dict):
+            exports.append(blob)
+    return devtel.dedup_exports(exports)
+
+
 def trace_timeline_response(
     broker: Broker, req_id: str, fmt: str = "",
 ) -> tuple[int, dict]:
     """GET /trace/{req_id}: the stitched fleet-wide timeline (404 when no
     process recorded the id). ``fmt == "chrome"`` returns Chrome
-    trace-event JSON loadable in Perfetto instead."""
+    trace-event JSON loadable in Perfetto instead — with the fleet's
+    devtel counter tracks (KV occupancy, queue depth, MFU/MBU, memory)
+    alongside the request's spans, so the timeline shows *why* it waited."""
     exports = collect_trace_exports(broker)
     if fmt == "chrome":
         if not trace.stitch(exports, req_id=req_id):
             return 404, {"error": f"no trace for {req_id}"}
-        return 200, trace.to_chrome_trace(exports, req_id=req_id)
+        return 200, trace.to_chrome_trace(
+            exports, req_id=req_id,
+            counters=collect_devtel_exports(broker),
+        )
     tl = trace.timeline(exports, req_id)
     if tl is None:
         return 404, {"error": f"no trace for {req_id}"}
@@ -137,7 +167,13 @@ def start_profile(
     """POST /profile: capture an on-demand ``jax.profiler`` trace for
     ``duration_s`` seconds in a background thread (the serving loop keeps
     running — the profiler observes it). 409 while one is in flight; 501
-    when jax is not importable (the producer itself never needs it)."""
+    when jax is not importable (the producer itself never needs it).
+
+    The in-flight slot carries a hard expiry (``duration_s`` + grace): a
+    caller whose capture thread died or hung past its own cap no longer
+    wedges profiling until process restart — the next POST force-stops
+    the orphaned profiler session and takes the slot over."""
+    global _PROFILE_ACTIVE, _PROFILE_GEN, _PROFILE_DEADLINE
     import tempfile
     import time as _time
 
@@ -146,26 +182,50 @@ def start_profile(
     except (TypeError, ValueError):
         return 400, {"error": "duration_s must be a number"}
     try:
-        import jax  # noqa: F401 — availability gate only
+        import jax
     except Exception as e:  # noqa: BLE001 — report, don't crash the route
         return 501, {"error": f"jax unavailable: {e}"}
-    if not _PROFILE_LOCK.acquire(blocking=False):
-        return 409, {"error": "profile already in progress"}
+    with _PROFILE_LOCK:
+        now = _time.monotonic()
+        if _PROFILE_ACTIVE and now < _PROFILE_DEADLINE:
+            return 409, {
+                "error": "profile already in progress",
+                "retry_after_s": round(_PROFILE_DEADLINE - now, 3),
+            }
+        stolen = bool(_PROFILE_ACTIVE)
+        _PROFILE_GEN += 1
+        gen = _PROFILE_ACTIVE = _PROFILE_GEN
+        _PROFILE_DEADLINE = now + duration_s + _PROFILE_GRACE_S
+    if stolen:
+        # The previous holder blew through its own duration cap: its
+        # capture thread is hung or dead, but jax's one-global-trace may
+        # still be recording. Stop it so our start_trace doesn't fail.
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001 — already stopped is fine
+            pass
     if log_dir is None:
         log_dir = tempfile.mkdtemp(prefix="llmss-profile-")
 
     def run():
+        global _PROFILE_ACTIVE
         try:
             with profile_trace(log_dir):
                 _time.sleep(duration_s)
         except Exception:  # noqa: BLE001 — background capture best-effort
             pass
         finally:
-            _PROFILE_LOCK.release()
+            with _PROFILE_LOCK:
+                # Only the still-current generation frees the slot — a
+                # stolen-from thread waking up late must not release the
+                # thief's in-flight profile.
+                if _PROFILE_ACTIVE == gen:
+                    _PROFILE_ACTIVE = 0
 
     threading.Thread(target=run, daemon=True).start()
     return 202, {
         "profiling": True, "log_dir": log_dir, "duration_s": duration_s,
+        **({"stole_wedged_slot": True} if stolen else {}),
     }
 
 
@@ -324,6 +384,8 @@ class ProducerServer:
                     self._reply(200, outer.fleet())
                 elif path == "/slo":
                     self._reply(200, outer.slo())
+                elif path == "/compiles":
+                    self._reply(200, outer.compiles())
                 elif path == "/metrics":
                     payload = outer.metrics_payload()
                     if q.get("format", [""])[0] == "prometheus":
@@ -335,6 +397,9 @@ class ProducerServer:
                                 payload,
                                 series=metrics_mod.cumulative_summary(
                                     exports,
+                                ),
+                                util=devtel.merged_gauges(
+                                    collect_devtel_exports(outer.broker),
                                 ),
                             ),
                             _PROM_CONTENT_TYPE,
@@ -605,6 +670,15 @@ class ProducerServer:
         fleet = self.fleet_metrics()
         if fleet is not None:
             payload["fleet"] = fleet
+        dt = collect_devtel_exports(self.broker)
+        if dt:
+            # Device telemetry gauges: only present when the plane is on
+            # somewhere in the fleet — the pre-devtel payload stays
+            # byte-identical otherwise.
+            payload["devtel"] = {
+                **devtel.merged_gauges(dt),
+                "compiles": devtel.recompile_flag(dt),
+            }
         return payload
 
     def trace_slowest(
@@ -620,9 +694,22 @@ class ProducerServer:
     def slo(self) -> dict:
         """GET /slo: per-objective attainment and multi-window burn rates
         from the windowed fleet-aggregated series — the signal the
-        autoscaler and priority scheduler consume."""
+        autoscaler and priority scheduler consume. When the devtel plane
+        is on, a ``compile`` block flags steady-state recompiles: an
+        unbudgeted multi-second XLA stall some request just ate."""
         exports, _src = collect_series_exports(self.broker)
-        return metrics_mod.evaluate_slos(exports, self.slo_objectives)
+        out = metrics_mod.evaluate_slos(exports, self.slo_objectives)
+        dt = collect_devtel_exports(self.broker)
+        if dt:
+            out["compile"] = devtel.recompile_flag(dt)
+        return out
+
+    def compiles(self) -> dict:
+        """GET /compiles: fleet-wide compile forensics — every recorded
+        compilation (name, duration when known, triggering req_id when
+        attributable) wall-aligned and newest-last, plus the steady-state
+        recompile rollup."""
+        return devtel.compiles_payload(collect_devtel_exports(self.broker))
 
     def timeseries(self) -> dict:
         """GET /fleet/timeseries: per-worker/per-series windowed points on
@@ -897,12 +984,19 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
             if router is not None:
                 fleet["router"] = router.stats()
             payload["fleet"] = fleet
+        dt = collect_devtel_exports(broker)
+        if dt:
+            payload["devtel"] = {
+                **devtel.merged_gauges(dt),
+                "compiles": devtel.recompile_flag(dt),
+            }
         if format == "prometheus":
             exports, _src = collect_series_exports(broker)
             return PlainTextResponse(
                 render_prometheus(
                     payload,
                     series=metrics_mod.cumulative_summary(exports),
+                    util=devtel.merged_gauges(dt),
                 ),
                 media_type=_PROM_CONTENT_TYPE,
             )
@@ -911,7 +1005,15 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
     @app.get("/slo")
     def slo():
         exports, _src = collect_series_exports(broker)
-        return metrics_mod.evaluate_slos(exports, slo_objectives)
+        out = metrics_mod.evaluate_slos(exports, slo_objectives)
+        dt = collect_devtel_exports(broker)
+        if dt:
+            out["compile"] = devtel.recompile_flag(dt)
+        return out
+
+    @app.get("/compiles")
+    def compiles():
+        return devtel.compiles_payload(collect_devtel_exports(broker))
 
     @app.get("/fleet/timeseries")
     def fleet_timeseries():

@@ -504,6 +504,10 @@ class SeriesRegistry:
         # resolved cost-ingestion sinks (observe_request_cost); rebuilt
         # lazily — a stale read just re-resolves, so no lock needed
         self._cost_sinks: tuple | None = None
+        # bumped on clear(); external sink caches (devtel's MFU/MBU
+        # histograms) compare against this so a cleared registry never
+        # keeps receiving folds into orphaned series objects
+        self._gen = 0  # guarded_by: self._lock
 
     def counter(self, name: str) -> WindowedCounter:
         with self._lock:
@@ -525,11 +529,17 @@ class SeriesRegistry:
         with self._lock:
             return sorted(self._series)
 
+    def generation(self) -> int:
+        """Monotone clear() counter for invalidating cached sink refs."""
+        with self._lock:
+            return self._gen
+
     def clear(self) -> None:
         with self._lock:
             self._series.clear()
             self._cache = None
             self._cache_t = float("-inf")
+            self._gen += 1
         self._cost_sinks = None
 
     def export(self, cache_s: float = 0.0) -> dict:
@@ -899,8 +909,21 @@ def _prom_name(parts) -> str:
     return "".join(c if (c.isalnum() or c == "_") else "_" for c in raw)
 
 
+def _prom_label_value(v) -> str:
+    """Escape a label value per the Prometheus text-format spec:
+    backslash, double-quote, and newline must be escaped inside the
+    quoted value, else a hostile worker_id corrupts the whole scrape."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def render_prometheus(
     payload: dict, prefix: str = "llmss", series: dict | None = None,
+    util: dict | None = None,
 ) -> str:
     """Render the ``GET /metrics`` JSON payload in Prometheus text
     exposition format (``?format=prometheus``).
@@ -915,6 +938,11 @@ def render_prometheus(
     adds real cumulative histogram families — ``_bucket`` with ``le``
     labels plus ``_sum``/``_count`` — so Grafana/alerting can compute
     rates without scraping quantile gauges.
+
+    ``util`` (a ``devtel.merged_gauges`` dict: ``{"mfu": {kernel: v},
+    "mbu": ...}``) adds the roofline gauges ``<prefix>_mfu`` /
+    ``<prefix>_mbu`` labelled by kernel class — the label set is the
+    closed ``devtel.KERNEL_CLASSES`` enum, so cardinality is bounded.
     """
     samples: dict[str, list[tuple[dict | None, object]]] = {}
 
@@ -962,6 +990,10 @@ def render_prometheus(
                 if isinstance(snap, dict):
                     walk(snap, ["fleet", "worker"], {"worker": wid})
 
+    for fam in ("mfu", "mbu"):
+        for kernel, v in sorted(((util or {}).get(fam) or {}).items()):
+            emit(f"{prefix}_{fam}", v, {"kernel": kernel})
+
     lines: list[str] = []
     for name in samples:
         lines.append(f"# TYPE {name} gauge")
@@ -969,7 +1001,8 @@ def render_prometheus(
             lab = ""
             if labels:
                 body = ",".join(
-                    f'{k}="{v}"' for k, v in sorted(labels.items())
+                    f'{k}="{_prom_label_value(v)}"'
+                    for k, v in sorted(labels.items())
                 )
                 lab = "{" + body + "}"
             lines.append(f"{name}{lab} {value}")

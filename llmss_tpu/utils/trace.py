@@ -591,18 +591,41 @@ def export_workload(exports) -> dict:
     }
 
 
-def to_chrome_trace(exports, req_id: str | None = None) -> dict:
+def to_chrome_trace(
+    exports, req_id: str | None = None, counters=None,
+) -> dict:
     """Chrome trace-event JSON (loadable at ui.perfetto.dev): one pid per
     process label, one tid per request, ``X`` complete events for spans and
-    ``i`` instants for point events, timestamps in microseconds."""
+    ``i`` instants for point events, timestamps in microseconds.
+
+    ``counters`` is an optional list of devtel export blobs (each carrying
+    its own ``mono_anchor``/``wall_anchor`` pair plus ``counters`` samples
+    of ``{"t": mono, "tracks": {name: {series: value}}}``); each track
+    becomes a ``C`` counter row under its process, wall-aligned exactly
+    like span events, so KV occupancy / queue depth / memory ride the
+    same timeline as the requests that waited on them.
+    """
     evs = stitch(exports, req_id)
     out: list[dict] = []
     pids: dict[str, int] = {}
     tids: dict[tuple, int] = {}
+    # Wall-align counter samples up front so t0 covers them too: a trace
+    # that opens with a counter sample must not produce negative ts.
+    csamples: list[tuple[str, float, dict]] = []  # (proc, ts_wall, tracks)
+    for ex in counters or ():
+        base = ex.get("wall_anchor", 0.0) - ex.get("mono_anchor", 0.0)
+        proc = ex.get("proc", "?")
+        for s in ex.get("counters", ()):
+            csamples.append((proc, base + s.get("t", 0.0), s.get("tracks") or {}))
     t0 = evs[0]["ts_wall"] if evs else 0.0
+    if csamples:
+        ct0 = min(ts for _, ts, _ in csamples)
+        t0 = min(t0, ct0) if evs else ct0
     for e in evs:
         pid = pids.setdefault(e["proc"], len(pids) + 1)
         tids.setdefault((e["proc"], e["req_id"]), len(tids) + 1)
+    for proc, _ts_w, _tracks in csamples:
+        pids.setdefault(proc, len(pids) + 1)
     for proc, pid in pids.items():
         out.append({
             "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
@@ -631,8 +654,18 @@ def to_chrome_trace(exports, req_id: str | None = None) -> dict:
                 "ph": "i", "pid": pid, "tid": tid, "name": e["name"],
                 "cat": "event", "ts": ts, "s": "t", "args": args,
             })
+    for proc, ts_wall, tracks in csamples:
+        pid = pids[proc]
+        for track, values in tracks.items():
+            out.append({
+                "ph": "C", "pid": pid, "tid": 0, "name": track,
+                "cat": "counter", "ts": (ts_wall - t0) * 1e6,
+                "args": dict(values),
+            })
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 
-def chrome_trace_json(exports, req_id: str | None = None) -> str:
-    return json.dumps(to_chrome_trace(exports, req_id))
+def chrome_trace_json(
+    exports, req_id: str | None = None, counters=None,
+) -> str:
+    return json.dumps(to_chrome_trace(exports, req_id, counters=counters))

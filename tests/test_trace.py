@@ -334,7 +334,8 @@ def test_chrome_trace_export_valid():
     doc = json.loads(trace.chrome_trace_json(exports))  # valid JSON
     assert doc["displayTimeUnit"] == "ms"
     evs = doc["traceEvents"]
-    assert {e["ph"] for e in evs} <= {"M", "X", "i"}
+    # "C" = devtel counter tracks (KV blocks, MFU/MBU, queue depths).
+    assert {e["ph"] for e in evs} <= {"M", "X", "i", "C"}
     procs = {
         e["args"]["name"] for e in evs
         if e["ph"] == "M" and e["name"] == "process_name"
@@ -436,7 +437,9 @@ def test_profile_endpoint_serializes_captures(tmp_path):
         r2 = httpx.post(f"{base}/profile", json={"duration_s": 0.1})
         assert r2.status_code == 409
         deadline = time.monotonic() + 10.0
-        while producer_mod._PROFILE_LOCK.locked():
+        # The slot (not the lock — that's only held for bookkeeping) is
+        # what the capture thread frees on completion.
+        while producer_mod._PROFILE_ACTIVE:
             assert time.monotonic() < deadline, "profile never finished"
             time.sleep(0.05)
     finally:

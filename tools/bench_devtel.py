@@ -1,0 +1,252 @@
+"""Devtel-overhead bench: the device telemetry plane on vs off.
+
+The devtel plane (utils/devtel.py) rides the grouped-decode hot path:
+one cost-table lookup at dispatch, one MFU/MBU fold at fetch, one
+throttle check per group for the compile sampler and the counter tracks.
+Its acceptance bar (ISSUE 15): fully enabled it adds **≤ 2 µs host
+overhead per group dispatch** and **< 1% end-to-end throughput** vs
+disabled, and a mixed-trace run's Perfetto export carries MFU/MBU
+samples, ≥ 3 counter tracks, and ≥ 1 attributed compile span, with a
+forced mid-serve recompile flagged on ``/slo``.
+
+Three passes pin those numbers:
+
+1. **Per-group microcost** — the exact per-group devtel work (cost-table
+   hit + fold + both throttle checks) timed directly over many
+   iterations. Wall-clock A/B on a real serve loop cannot resolve 2 µs
+   under CPU scheduler noise; timing the added code path itself can
+   (the bench_trace.py best-of discipline, applied at finer grain).
+2. **End-to-end throughput** — a real tiny-engine ``ContinuousBatcher``
+   serve pass, devtel on vs off, best-of-REPEATS; the acceptance delta.
+3. **Artifact checks** — from the enabled run: the Perfetto export's
+   counter tracks and compile spans, plus a forced mid-serve recompile
+   surfaced through the REAL ``/slo`` and ``/compiles`` payload code
+   (a ``ProducerServer`` over an ``InProcBroker``; no sockets).
+
+CPU-only (JAX_PLATFORMS=cpu, the tests/conftest.py 8-device mesh);
+MFU/MBU values are roofline-SHAPED but not meaningful in absolute terms
+off-TPU (docs/observability.md). Writes DEVTEL_BENCH.json; prints one
+JSON line per metric, headline last.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+REPEATS = int(os.environ.get("DEVTEL_BENCH_REPEATS", 3))
+N_REQUESTS = int(os.environ.get("DEVTEL_BENCH_REQUESTS", 12))
+MAX_NEW = int(os.environ.get("DEVTEL_BENCH_MAX_NEW", 16))
+MICRO_ITERS = int(os.environ.get("DEVTEL_BENCH_MICRO_ITERS", 20000))
+
+
+def make_batcher():
+    import jax
+
+    from llmss_tpu.engine import DecodeEngine
+    from llmss_tpu.engine.scheduler import ContinuousBatcher
+    from llmss_tpu.models.common import DecoderConfig
+    from llmss_tpu.models.decoder import init_params
+    from llmss_tpu.parallel import MeshPlan, make_mesh
+
+    cfg = DecoderConfig(
+        model_type="llama", vocab_size=64, hidden_size=32, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=8, intermediate_size=64,
+        max_position_embeddings=64, activation="silu", norm="rmsnorm",
+        norm_eps=1e-5, mlp="swiglu", positions="rotary", rope_style="half",
+        rotary_dim=8, attn_bias=False, mlp_bias=False,
+        tie_word_embeddings=False, dtype="float32",
+    )
+    mesh = make_mesh(MeshPlan(dp=2, tp=4))
+    params = init_params(cfg, mesh, jax.random.key(0))
+    engine = DecodeEngine(cfg, params, mesh, max_seq_len=64)
+    batcher = ContinuousBatcher(engine, rows=4, chunk_steps=2, group_chunks=2)
+    batcher.prewarm()
+    return engine, batcher
+
+
+def serve_pass(engine, batcher, devtel_on: bool) -> tuple[float, int]:
+    """One serve pass of N_REQUESTS; returns (wall_s, groups_dispatched)."""
+    from llmss_tpu.engine import GenerationParams
+    from llmss_tpu.utils import devtel
+
+    devtel.set_enabled(devtel_on)
+    def groups() -> int:
+        return engine.metrics.to_dict()["host_overhead"]["groups_dispatched"]
+
+    gen = GenerationParams(max_new_tokens=MAX_NEW, is_greedy=True)
+    got = {}
+    g0 = groups()
+    t0 = time.monotonic()
+    for i in range(N_REQUESTS):
+        batcher.submit(
+            [(3 * i + j) % 63 + 1 for j in range(4)], gen,
+            lambda t, i=i: got.__setitem__(i, t), req_id=f"dvb-{i}",
+        )
+    batcher.run_until_idle()
+    wall = time.monotonic() - t0
+    assert len(got) == N_REQUESTS, f"lost requests: {len(got)}"
+    return wall, groups() - g0
+
+
+def micro_cost(engine) -> float:
+    """µs per group of the devtel hot path: the dispatch-side cost-table
+    hit, the fetch-side fold, and both per-group throttle checks — the
+    complete set of instructions a group pays when devtel is on."""
+    from llmss_tpu.utils import devtel
+
+    devtel.set_enabled(True)
+    obs = devtel.observer()
+    cost = engine.devtel_cost(
+        "decode_group", (4, 2, 2, 32), batch=4, steps=4, kv_len=32,
+    )
+    assert cost is not None
+    # Warm the fold sinks so the loop times the steady path, not the
+    # first-call series registration.
+    devtel.fold("decode_group", 0.004, cost)
+    best = float("inf")
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        for _ in range(MICRO_ITERS):
+            c = engine.devtel_cost(
+                "decode_group", (4, 2, 2, 32), batch=4, steps=4, kv_len=32,
+            )
+            devtel.fold("decode_group", 0.004, c)
+            obs.maybe_sample("req")  # throttled: monotonic read + compare
+        best = min(best, (time.perf_counter() - t0) / MICRO_ITERS * 1e6)
+    return best
+
+
+def main() -> int:
+    from llmss_tpu.serve.broker import InProcBroker
+    from llmss_tpu.serve.producer import ProducerServer
+    from llmss_tpu.utils import devtel, trace
+
+    from bench import bench_provenance  # repo root, on sys.path above
+
+    trace.set_enabled(True)
+    devtel.reset()
+    engine, batcher = make_batcher()
+
+    # Pass 1 — the per-group microcost.
+    host_us_per_group = micro_cost(engine)
+
+    # Pass 2 — end-to-end throughput A/B (best-of-REPEATS each mode).
+    best = {"on": float("inf"), "off": float("inf")}
+    groups = 0
+    for _ in range(REPEATS):
+        for mode in ("off", "on"):
+            wall, g = serve_pass(engine, batcher, mode == "on")
+            best[mode] = min(best[mode], wall)
+            if mode == "on":
+                groups += g
+    tokens = N_REQUESTS * MAX_NEW
+    tput_off = tokens / best["off"]
+    tput_on = tokens / best["on"]
+    overhead_pct = (best["on"] - best["off"]) / best["off"] * 100.0
+
+    # Pass 3 — artifact checks from the enabled state accumulated above.
+    devtel.set_enabled(True)
+    # Force a mid-serve recompile: the decode executable at a batch the
+    # prewarm envelope never covered, observed by the group-boundary
+    # cache sweep and attributed to an in-flight request id.
+    import jax.numpy as jnp
+
+    from llmss_tpu.engine import GenerationParams
+
+    devtel.observer()._last_sample = float("-inf")
+    b = 2  # batcher prewarmed batch=4; 2 is a fresh executable signature
+    engine._decode(
+        engine.params, engine.canon_vec(jnp.zeros(b, jnp.int32)),
+        engine.canon_cache(engine.new_cache(b)),
+        engine.canon_vec(jnp.ones(b, jnp.int32)),
+        engine._sample_args(GenerationParams(), b), t_bucket=None,
+    )
+    devtel.observer().maybe_sample("dvb-forced")
+
+    ps = ProducerServer(broker=InProcBroker())
+    slo = ps.slo()
+    compiles = ps.compiles()
+    chrome = trace.to_chrome_trace(
+        [trace.recorder().export()],
+        counters=[devtel.export()],
+    )
+    counter_tracks = sorted({
+        e["name"] for e in chrome["traceEvents"] if e["ph"] == "C"
+    })
+    compile_spans = [
+        e for e in chrome["traceEvents"]
+        if e["ph"] in ("X", "i") and e["name"] == "compile"
+    ]
+    attributed = [
+        e for e in compiles["compiles"] if e.get("req_id") == "dvb-forced"
+    ]
+    util = devtel.last_util()
+    mfu_ok = all(
+        0.0 < g["mfu"] <= 1.0 or g["mbu"] > 0.0 for g in util.values()
+    ) and bool(util)
+
+    checks = {
+        "host_overhead_le_2us": host_us_per_group <= 2.0,
+        # One-sided: the contract is "on is not >1% slower"; a negative
+        # delta (on measured faster) is CPU wall-clock noise, not a fail.
+        "throughput_delta_lt_1pct": overhead_pct < 1.0,
+        "perfetto_mfu_mbu_samples": "mfu" in counter_tracks
+                                    and "mbu" in counter_tracks,
+        "perfetto_counter_tracks_ge_3": len(counter_tracks) >= 3,
+        "perfetto_compile_span": len(compile_spans) >= 1,
+        "attributed_compile": len(attributed) >= 1,
+        "slo_flags_recompile": bool(
+            slo.get("compile", {}).get("flagged"),
+        ),
+        "util_samples_in_unit_interval": mfu_ok,
+    }
+    out = {
+        "bench": "devtel_overhead",
+        "provenance": bench_provenance(),
+        "requests": N_REQUESTS,
+        "max_new_tokens": MAX_NEW,
+        "repeats": REPEATS,
+        "micro_iters": MICRO_ITERS,
+        "group_dispatches_on": groups,
+        "host_overhead_us_per_group": round(host_us_per_group, 3),
+        "wall_s_devtel_off": round(best["off"], 4),
+        "wall_s_devtel_on": round(best["on"], 4),
+        "tok_per_s_devtel_off": round(tput_off, 1),
+        "tok_per_s_devtel_on": round(tput_on, 1),
+        "overhead_pct": round(overhead_pct, 2),
+        "counter_tracks": counter_tracks,
+        "n_compile_events": compiles["n_compiles"],
+        "steady_state_recompiles": compiles["steady_recompiles"],
+        "util": {
+            k: {"mfu": g["mfu"], "mbu": g["mbu"], "source": g["source"]}
+            for k, g in util.items()
+        },
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    with open("DEVTEL_BENCH.json", "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    for key in ("overhead_pct",):
+        print(json.dumps({
+            "metric": "devtel_" + key, "value": out[key], "unit": "%",
+        }))
+    print(json.dumps({
+        "metric": "devtel_host_overhead_us_per_group",
+        "value": out["host_overhead_us_per_group"],
+        "unit": "us/group (budget 2.0)",
+        "vs_baseline": out["ok"],
+    }))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
