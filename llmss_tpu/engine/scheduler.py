@@ -132,6 +132,31 @@ class _InFlightGroup:
     cost: object = None  # devtel.KernelCost | None
 
 
+def select_preemption_victim(candidates, head_priority: int):
+    """Pick the row to evict for a blocked head request, or ``None``.
+
+    ``candidates`` is an iterable of ``(key, priority, emitted_tokens)``
+    for the rows that are *evictable at all* (the caller applies its own
+    structural filters — settled, refundable, not mid-prefill). Policy:
+    only rows strictly outranked by the head (``priority >
+    head_priority``) qualify; among those, evict the lowest class first,
+    ties broken by FEWEST emitted tokens — the cheapest replay prefill.
+    Exact ties keep the first candidate, so iteration order is part of
+    the contract (dict order for the batcher, row order for the sim).
+
+    Factored to module level so the fleet simulator preempts with the
+    scheduler's REAL policy rather than a re-implementation; both
+    ``ContinuousBatcher._maybe_preempt`` and ``sim.replica`` call this.
+    """
+    victim = None
+    for key, priority, emitted in candidates:
+        if priority <= head_priority:
+            continue
+        if victim is None or (priority, -emitted) > (victim[1], -victim[2]):
+            victim = (key, priority, emitted)
+    return None if victim is None else victim[0]
+
+
 class ContinuousBatcher:
     def __init__(
         self, engine: DecodeEngine, *, rows: int = 8, chunk_steps: int = 1,
@@ -1108,26 +1133,20 @@ class ContinuousBatcher:
             blocked = need > self.allocator.free_blocks
         if not blocked:
             return 0
-        victim = None
-        for row, r in self.active.items():
+        candidates = [
+            (row, r.priority, len(r.out))
+            for row, r in self.active.items()
             # Only settled rows are evictable: a row awaiting its first
             # token (admission in flight, or prompt still streaming
             # through ragged chunks) has no resume point yet, and an
             # anonymous row can't be refunded to a broker.
-            if r.awaiting_first or not r.req_id:
-                continue
-            if r.priority <= head_pri or row in self._inflight_prefill:
-                continue
-            if victim is None or (
-                (r.priority, -len(r.out))
-                > (victim[1].priority, -len(victim[1].out))
-            ):
-                # Lowest class first; ties evict the row with the FEWEST
-                # emitted tokens — the cheapest replay prefill.
-                victim = (row, r)
-        if victim is None:
+            if r.req_id and not r.awaiting_first
+            and row not in self._inflight_prefill
+        ]
+        row = select_preemption_victim(candidates, head_pri)
+        if row is None:
             return 0
-        row, r = victim
+        r = self.active[row]
         self._flush_stream(r)
         self.active.pop(row, None)
         self._row_pos.pop(row, None)

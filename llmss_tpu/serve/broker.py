@@ -37,6 +37,7 @@ from __future__ import annotations
 import abc
 import collections
 import queue
+import random
 import threading
 import time
 
@@ -63,6 +64,8 @@ def _enqueue_attrs(req: GenerateRequest) -> dict:
     if req.prefix_token_ids:
         a["prefix"] = prefix_hash(req.prefix_token_ids)
     a["slo_class"] = req.slo_class
+    if req.session_id:
+        a["session"] = req.session_id
     return a
 
 
@@ -1042,6 +1045,55 @@ class InProcBroker(Broker):
             return self._responses.pop(request_id)
 
 
+class _RetryingClient:
+    """Transient-fault retry proxy around a Redis-compatible client.
+
+    Commands that fail with a builtin ``ConnectionError`` or
+    ``TimeoutError`` (the real ``redis`` package's exceptions subclass
+    these) are retried with capped exponential backoff plus jitter, then
+    re-raised once the attempt budget is spent. Command replay is safe
+    under the broker's at-least-once contract: a retried RPOP whose
+    first attempt actually executed server-side before the connection
+    died looks exactly like a worker that died holding a lease — the
+    reaper redelivers it, and responses are consumed once by id.
+
+    Attribute access passes through; only callables are wrapped.
+    Generator-returning commands (``scan_iter``) retry the call, not the
+    iteration. ``retries`` counts every backed-off attempt and surfaces
+    as ``broker_retries`` in ``delivery_stats``.
+    """
+
+    def __init__(self, client, *, attempts: int = 5, base_s: float = 0.05,
+                 cap_s: float = 2.0, seed: int = 0):
+        self._client = client
+        self._attempts = max(1, int(attempts))
+        self._base_s = base_s
+        self._cap_s = cap_s
+        self._rng = random.Random(seed)
+        self.retries = 0
+
+    def __getattr__(self, name):
+        attr = getattr(self._client, name)
+        if not callable(attr):
+            return attr
+
+        def call(*args, **kwargs):
+            for attempt in range(self._attempts):
+                try:
+                    return attr(*args, **kwargs)
+                except (ConnectionError, TimeoutError):
+                    if attempt == self._attempts - 1:
+                        raise
+                    self.retries += 1
+                    # Full jitter on a capped exponential ladder: spreads
+                    # a thundering herd of reconnecting workers without
+                    # stretching the common single-blip case.
+                    delay = min(self._cap_s, self._base_s * (2 ** attempt))
+                    time.sleep(delay * (0.5 + self._rng.random() / 2))
+
+        return call
+
+
 class RedisBroker(Broker):
     """Wire-compatible with the reference's Redis lists, id-corrected.
 
@@ -1074,12 +1126,20 @@ class RedisBroker(Broker):
                  cancel_prefix: str = "cancelled", *, client=None,
                  worker_id: str | None = None, lease_s: float | None = None,
                  max_delivery_attempts: int | None = None,
-                 worker_ttl_s: float | None = None):
+                 worker_ttl_s: float | None = None,
+                 retry_attempts: int = 5, retry_base_s: float = 0.05,
+                 retry_cap_s: float = 2.0):
         if client is None:
             import redis  # gated: optional dependency
 
             client = redis.Redis(host=host, port=port)
-        self._r = client
+        # Every command rides the transient-fault retry ladder
+        # (``retry_attempts=1`` disables retries); the count surfaces as
+        # ``broker_retries`` in ``delivery_stats``.
+        self._r = _RetryingClient(
+            client, attempts=retry_attempts, base_s=retry_base_s,
+            cap_s=retry_cap_s,
+        )
         self._rq = request_queue
         self._prefix = response_prefix
         self._cancel_prefix = cancel_prefix
@@ -1654,6 +1714,7 @@ class RedisBroker(Broker):
             "dlq_depth": self.dlq_depth(),
             "handoff_depth": self.handoff_depth(),
             "handoff_inflight": handoff_inflight,
+            "broker_retries": self._r.retries,
             **{k: int(v or 0) for k, v in zip(names, vals)},
         }
 

@@ -62,9 +62,13 @@ class ChaosWorkerHost:
     abort path (its leased requests are recovered only by broker
     redelivery) and a fresh worker is spawned after ``respawn_delay_s``.
     With ``respawn=False`` the first kill is permanent (the "machine" never
-    comes back) — the shape fleet-failover tests need. Any ordinary
-    ``Exception`` is a harness bug: recorded and re-raised so tests fail
-    loudly instead of spinning.
+    comes back) — the shape fleet-failover tests need. A builtin
+    ``ConnectionError`` (a ``ChaosBroker`` partition window, a Redis
+    blip past the client's retry budget) is a *reconnect*, not a death:
+    the worker object is rebuilt after a short pause, its held leases
+    left to rot to redelivery. Any other ordinary ``Exception`` is a
+    harness bug: recorded and re-raised so tests fail loudly instead of
+    spinning.
     """
 
     def __init__(self, worker_factory: Callable[[], object], *,
@@ -74,6 +78,7 @@ class ChaosWorkerHost:
         self.respawn = respawn
         self.kills = 0
         self.spawns = 0
+        self.reconnects = 0
         self.error: str | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -90,6 +95,11 @@ class ChaosWorkerHost:
                 logger.debug("chaos host: worker hard-killed (%s)", e)
                 if not self.respawn:
                     return
+                if self._stop.wait(self.respawn_delay_s):
+                    return
+            except ConnectionError as e:
+                self.reconnects += 1
+                logger.debug("chaos host: broker unreachable (%s)", e)
                 if self._stop.wait(self.respawn_delay_s):
                     return
             except Exception as e:  # noqa: BLE001 — surface harness bugs
@@ -125,6 +135,14 @@ class ChaosBroker:
     - ``ack_delay_s``: sleep before every delivered ``push_response``
       (slow-ack window: widens the race between a slow worker answering
       and the reaper redelivering).
+    - ``op_latency_s`` (+ ``op_latency_prob``): sleep before delegating
+      a ``pop_request``/``push_response`` — a broker latency spike, the
+      soft sibling of a partition.
+    - ``partition_for(duration_s)``: until the window elapses, every
+      ``pop_request``/``push_response`` raises builtin
+      ``ConnectionError`` — the worker's view of a network partition.
+      ``ChaosWorkerHost`` treats that as a reconnect (not a death), so
+      leases held across the partition rot and must be redelivered.
 
     Everything else delegates to the wrapped broker. Not for use under a
     ``Supervisor`` (its ``metrics_extra`` hook would land on the proxy, not
@@ -136,19 +154,39 @@ class ChaosBroker:
                  kill_after_pop_prob: float = 0.0,
                  drop_response_prob: float = 0.0,
                  pop_fail_prob: float = 0.0,
-                 ack_delay_s: float = 0.0):
+                 ack_delay_s: float = 0.0,
+                 op_latency_s: float = 0.0,
+                 op_latency_prob: float = 1.0):
         self.inner = inner
         self.kill_after_pop_prob = kill_after_pop_prob
         self.drop_response_prob = drop_response_prob
         self.pop_fail_prob = pop_fail_prob
         self.ack_delay_s = ack_delay_s
+        self.op_latency_s = op_latency_s
+        self.op_latency_prob = op_latency_prob
+        self._partition_until = 0.0
         self._rng = random.Random(seed)
-        self.faults = {"kills": 0, "dropped_responses": 0, "dropped_pops": 0}
+        self.faults = {"kills": 0, "dropped_responses": 0, "dropped_pops": 0,
+                       "partition_errors": 0, "latency_injections": 0}
 
     def __getattr__(self, name):
         return getattr(self.inner, name)
 
+    def partition_for(self, duration_s: float) -> None:
+        """Open a partition window: broker ops raise ``ConnectionError``
+        until ``duration_s`` from now (wall clock)."""
+        self._partition_until = time.monotonic() + duration_s
+
+    def _gate(self) -> None:
+        if time.monotonic() < self._partition_until:
+            self.faults["partition_errors"] += 1
+            raise ConnectionError("chaos: broker partitioned")
+        if self.op_latency_s and self._rng.random() < self.op_latency_prob:
+            self.faults["latency_injections"] += 1
+            time.sleep(self.op_latency_s)
+
     def pop_request(self, timeout: float = 0.0, worker_id: str | None = None):
+        self._gate()
         if self.pop_fail_prob and self._rng.random() < self.pop_fail_prob:
             self.faults["dropped_pops"] += 1
             return None
@@ -163,6 +201,7 @@ class ChaosBroker:
         return req
 
     def push_response(self, resp) -> None:
+        self._gate()
         if self.ack_delay_s:
             time.sleep(self.ack_delay_s)
         if (
@@ -346,12 +385,24 @@ class FakeRedis:
     ``RedisBroker`` uses (string get/set/mget/delete/expire/incr, list
     lpush/rpush/rpop/brpop/llen/lrange, scan_iter), bytes-returning like a
     real client with ``decode_responses=False``, with lazy TTL expiry.
-    Thread-safe; ``brpop`` blocks on a condition variable."""
+    Thread-safe; ``brpop`` blocks on a condition variable.
+
+    ``fault_hook``, when set, is called with the command name at the top
+    of every operation (before any state is touched or lock taken);
+    raising from it — typically a builtin ``ConnectionError`` — injects
+    a transient broker fault, which is how tests drive ``RedisBroker``'s
+    capped-backoff retry path without a server."""
 
     def __init__(self):
         self._data: dict[str, object] = {}
         self._expiry: dict[str, float] = {}
         self._cond = threading.Condition()
+        self.fault_hook: Callable[[str], None] | None = None
+
+    def _fault(self, op: str) -> None:
+        hook = self.fault_hook
+        if hook is not None:
+            hook(op)
 
     @staticmethod
     def _k(key) -> str:
@@ -372,6 +423,7 @@ class FakeRedis:
     # -- strings ------------------------------------------------------------
 
     def set(self, key, value, ex=None):
+        self._fault("set")
         key = self._k(key)
         with self._cond:
             self._data[key] = self._b(value)
@@ -383,16 +435,19 @@ class FakeRedis:
         return True
 
     def get(self, key):
+        self._fault("get")
         with self._cond:
             v = self._live(self._k(key))
         return v if isinstance(v, bytes) else None
 
     def mget(self, keys):
+        self._fault("mget")
         with self._cond:
             vals = [self._live(self._k(k)) for k in keys]
         return [v if isinstance(v, bytes) else None for v in vals]
 
     def delete(self, *keys):
+        self._fault("delete")
         n = 0
         with self._cond:
             for key in keys:
@@ -404,6 +459,7 @@ class FakeRedis:
         return n
 
     def expire(self, key, seconds):
+        self._fault("expire")
         key = self._k(key)
         with self._cond:
             if self._live(key) is None:
@@ -412,6 +468,7 @@ class FakeRedis:
         return True
 
     def incr(self, key, amount=1):
+        self._fault("incr")
         key = self._k(key)
         with self._cond:
             v = self._live(key)
@@ -434,6 +491,7 @@ class FakeRedis:
             self._expiry.pop(key, None)
 
     def lpush(self, key, *values):
+        self._fault("lpush")
         key = self._k(key)
         with self._cond:
             lst = self._list(key)
@@ -443,6 +501,7 @@ class FakeRedis:
             return len(lst)
 
     def rpush(self, key, *values):
+        self._fault("rpush")
         key = self._k(key)
         with self._cond:
             lst = self._list(key)
@@ -451,6 +510,7 @@ class FakeRedis:
             return len(lst)
 
     def rpop(self, key):
+        self._fault("rpop")
         key = self._k(key)
         with self._cond:
             lst = self._live(key)
@@ -461,6 +521,7 @@ class FakeRedis:
             return v
 
     def brpop(self, key, timeout=0):
+        self._fault("brpop")
         key = self._k(key)
         # Redis blocks forever on timeout=0; poll in small quanta so lazy
         # TTL expiry elsewhere can't wedge a waiter.
@@ -478,11 +539,13 @@ class FakeRedis:
                 self._cond.wait(min(remaining, 0.05))
 
     def llen(self, key):
+        self._fault("llen")
         with self._cond:
             lst = self._live(self._k(key))
             return len(lst) if isinstance(lst, list) else 0
 
     def lrange(self, key, start, stop):
+        self._fault("lrange")
         with self._cond:
             lst = self._live(self._k(key))
             if not isinstance(lst, list):
@@ -497,6 +560,7 @@ class FakeRedis:
         ``RedisBroker`` stamps lease expiry against this shared clock; the
         fake derives it from ``time.monotonic()`` so tests are immune to
         wall-clock steps (all participants share this one instance)."""
+        self._fault("time")
         now = time.monotonic()
         sec = int(now)
         return (sec, int((now - sec) * 1e6))
@@ -504,6 +568,7 @@ class FakeRedis:
     # -- keyspace -----------------------------------------------------------
 
     def scan_iter(self, match="*"):
+        self._fault("scan_iter")
         with self._cond:
             keys = [k for k in self._data if fnmatch.fnmatch(k, match)]
         for key in keys:

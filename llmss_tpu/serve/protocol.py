@@ -114,6 +114,12 @@ class GenerateRequest:
     # decodes the remainder — greedy streams stay identical to an
     # unpreempted run because sampling depends only on (seed, position).
     resume_tokens: list[int] | None = None
+    # Session identity (optional, client- or producer-stamped): groups
+    # the requests of one conversation. Purely observational on the
+    # serving path — it rides trace enqueue attrs into
+    # ``/trace/export_workload`` so a replay can reproduce per-session
+    # arrival structure (and prefix-affinity pressure) from a capture.
+    session_id: str | None = None
     id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
 
     def to_json(self) -> str:

@@ -1,0 +1,48 @@
+"""Deterministic fleet simulator (ISSUE 16).
+
+A single-threaded virtual-clock event loop that drives the REAL serving
+stack — ``serve/broker.py`` (leases, class queues, DLQ, handoff
+channel), ``serve/fleet.py`` (Router, failover sweeps,
+BrownoutController), the scheduler's preemption policy
+(``engine/scheduler.select_preemption_victim``) and the
+``serve/handoff.py`` channel — under seeded fault storms, with a
+fleet-wide invariant checker asserted continuously.
+
+The sim never re-implements broker or fleet logic: replicas are thin
+actors that call ``pop_request`` / ``touch_requests`` /
+``push_handoff`` / ``push_response`` on a real broker instance whose
+clocks (``time.monotonic`` / ``time.time``) read the virtual clock.
+Everything nondeterministic — arrival processes, fault victim picks,
+poison placement — comes from one seeded ``random.Random``, so a
+scenario replays byte-identically (see docs/simulator.md).
+"""
+
+from llmss_tpu.sim.clock import VirtualClock
+from llmss_tpu.sim.cost import DeviceCostModel
+from llmss_tpu.sim.invariants import (
+    InvariantChecker,
+    InvariantViolation,
+    audit_exactly_once,
+    collect_responses,
+)
+from llmss_tpu.sim.loop import EventLoop
+from llmss_tpu.sim.scenario import (
+    SCENARIO_FORMAT,
+    FleetSim,
+    load_scenario,
+    run_scenario,
+)
+
+__all__ = [
+    "SCENARIO_FORMAT",
+    "DeviceCostModel",
+    "EventLoop",
+    "FleetSim",
+    "InvariantChecker",
+    "InvariantViolation",
+    "VirtualClock",
+    "audit_exactly_once",
+    "collect_responses",
+    "load_scenario",
+    "run_scenario",
+]

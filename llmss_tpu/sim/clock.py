@@ -1,0 +1,85 @@
+"""Virtual clock: the determinism seam under the real serving stack.
+
+The brokers judge lease expiry with ``time.monotonic()``, deadline
+shedding with ``time.time()``, and worker-health staleness with
+``heartbeat_ts`` wall stamps — all module-level lookups through the
+stdlib ``time`` module. ``VirtualClock.installed()`` swaps those
+functions for reads of a single float while a scenario runs, so the
+REAL lease reaper / failover sweep / brownout dwell code executes
+against simulated time with zero changes.
+
+Install is process-global and therefore only safe while the sim owns
+the process's notion of time: one thread, no concurrent wall-clock
+users. That is exactly the sim's execution model (the event loop is
+single-threaded by construction) and the context manager restores the
+real functions on exit, exceptions included.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time as _time
+
+# Virtual wall epoch: an arbitrary fixed date so deadline_ts and
+# heartbeat_ts stamps look like plausible epoch seconds. Fixed, never
+# sampled from the host — receipts must not depend on when a run starts.
+VIRTUAL_EPOCH_S = 1_700_000_000.0
+
+
+class VirtualClock:
+    __slots__ = ("_mono", "_epoch")
+
+    def __init__(self, start_s: float = 0.0,
+                 epoch_s: float = VIRTUAL_EPOCH_S):
+        self._mono = float(start_s)
+        self._epoch = float(epoch_s)
+
+    @property
+    def now(self) -> float:
+        return self._mono
+
+    # -- time-module-compatible callables ------------------------------------
+
+    def monotonic(self) -> float:
+        return self._mono
+
+    def perf_counter(self) -> float:
+        return self._mono
+
+    def time(self) -> float:
+        return self._epoch + self._mono
+
+    def sleep(self, seconds: float) -> None:
+        # Single-threaded world: the only thing a sleep can mean is
+        # "advance the clock" (used by the RedisBroker retry backoff
+        # when it runs under the sim).
+        if seconds > 0:
+            self._mono += seconds
+
+    # -- event-loop surface ---------------------------------------------------
+
+    def advance_to(self, t: float) -> None:
+        if t < self._mono:
+            raise ValueError(
+                f"virtual clock cannot run backwards: {t} < {self._mono}"
+            )
+        self._mono = t
+
+    @contextlib.contextmanager
+    def installed(self):
+        """Patch ``time.monotonic/time/perf_counter/sleep`` to this clock
+        for the duration of the block (restored on exit, always)."""
+        saved = (
+            _time.monotonic, _time.time, _time.perf_counter, _time.sleep,
+        )
+        _time.monotonic = self.monotonic
+        _time.time = self.time
+        _time.perf_counter = self.perf_counter
+        _time.sleep = self.sleep
+        try:
+            yield self
+        finally:
+            (
+                _time.monotonic, _time.time,
+                _time.perf_counter, _time.sleep,
+            ) = saved

@@ -1,0 +1,81 @@
+"""Fault-injection plane: WHEN bad things happen to WHICH replica.
+
+Two families of fault, matching how real incidents divide:
+
+- **Direct actor faults** (kill waves, heartbeat stalls, poison crashes)
+  act on a :class:`~llmss_tpu.sim.replica.SimReplica` at a scheduled
+  instant — the scenario engine fires them as plain events.
+- **Connectivity faults** (broker partitions, latency spikes) are
+  *intervals* registered here and queried by every replica on every work
+  cycle: ``broker_down`` makes all broker ops fail (the replica backs
+  off and, past the visibility timeout, fences itself), and
+  ``extra_latency`` stretches a cycle without stopping it (the
+  visibility-timeout race generator: a replica that keeps working but
+  touches leases late collides with the reaper's redelivery).
+
+Interval queries ride a per-target cursor: virtual time is monotonic per
+replica, so each lookup advances past dead intervals once and stays
+O(overlapping) — a million-cycle storm pays nothing for a long fault
+schedule. Target ``"*"`` applies to every replica.
+"""
+
+from __future__ import annotations
+
+import bisect
+
+
+class _Track:
+    __slots__ = ("intervals", "idx", "sorted")
+
+    def __init__(self):
+        self.intervals: list[tuple[float, float, float]] = []
+        self.idx = 0
+        self.sorted = True
+
+    def add(self, start: float, end: float, value: float) -> None:
+        bisect.insort(self.intervals, (start, end, value))
+        self.idx = 0
+
+    def active(self, now: float):
+        """Yield values of intervals covering ``now``; cursor skips
+        intervals that ended before it (monotonic ``now`` contract)."""
+        iv = self.intervals
+        while self.idx < len(iv) and iv[self.idx][1] < now:
+            self.idx += 1
+        j = self.idx
+        while j < len(iv) and iv[j][0] <= now:
+            if iv[j][1] >= now:
+                yield iv[j][2]
+            j += 1
+
+
+class FaultPlane:
+    def __init__(self):
+        self._partitions: dict[str, _Track] = {}
+        self._latency: dict[str, _Track] = {}
+
+    def add_partition(self, target: str, start: float, end: float) -> None:
+        """Broker unreachable for ``target`` (a worker id or ``"*"``)
+        over [start, end] virtual seconds."""
+        self._partitions.setdefault(target, _Track()).add(start, end, 1.0)
+
+    def add_latency(self, target: str, start: float, end: float,
+                    extra_s: float) -> None:
+        """Every work cycle of ``target`` takes ``extra_s`` longer over
+        [start, end] — overlapping spikes stack."""
+        self._latency.setdefault(target, _Track()).add(start, end, extra_s)
+
+    def broker_down(self, wid: str, now: float) -> bool:
+        for key in (wid, "*"):
+            track = self._partitions.get(key)
+            if track is not None and any(True for _ in track.active(now)):
+                return True
+        return False
+
+    def extra_latency(self, wid: str, now: float) -> float:
+        total = 0.0
+        for key in (wid, "*"):
+            track = self._latency.get(key)
+            if track is not None:
+                total += sum(track.active(now))
+        return total

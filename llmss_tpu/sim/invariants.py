@@ -1,0 +1,315 @@
+"""Fleet-wide invariant checking — the sim's reason to exist.
+
+One catalog, asserted two ways:
+
+- :class:`InvariantChecker` rides inside a simulation (or any
+  single-process harness): it wraps the broker's ``push_response`` so
+  every terminal answer is observed at the instant the REAL settle path
+  fires, tracks per-request expectations, and balances KV block
+  accounts that replicas charge through it.
+- :func:`collect_responses` / :func:`audit_exactly_once` are the
+  wall-clock flavor for the threaded chaos tests and
+  ``tools/chaos_serve.py`` parity runs (factored out of
+  tests/test_chaos.py so every legacy chaos test asserts the full set).
+
+The catalog (docs/simulator.md "Invariant catalog"):
+
+1.  exactly-one-terminal: every accepted request gets exactly one
+    terminal response — zero lost, zero double-answered;
+2.  payload exactness: successful payloads match the scripted engine's
+    deterministic tokens (corruption is a loss with extra steps);
+3.  DLQ-only-poison: dead-letters happen only to genuinely poisonous
+    requests, never to victims of kills/preemption/partitions;
+4.  preemption refunds: a request preempted N times must never be
+    dead-lettered for it (refunds outweigh the extra leases);
+5.  KV balance: every replica's block account returns to zero at drain
+    and never goes negative in between;
+6.  shed-is-terminal-at-the-edge: a brownout-shed request never also
+    receives a broker response (the 429 WAS its answer).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class InvariantViolation(AssertionError):
+    pass
+
+
+_DEADLINE_ERR = "deadline exceeded"
+_DEADLETTER_ERR = "dead-lettered"
+
+
+class _ReqRecord:
+    __slots__ = (
+        "expected_last", "max_new", "slo_class", "has_deadline",
+        "terminal", "dups", "preempts", "shed", "submit_t",
+    )
+
+    def __init__(self):
+        self.expected_last = None
+        self.max_new = 0
+        self.slo_class = "standard"
+        self.has_deadline = False
+        self.terminal = None
+        self.dups = 0
+        self.preempts = 0
+        self.shed = False
+        self.submit_t = 0.0
+
+
+class InvariantChecker:
+    """Continuous invariant accounting over one broker instance."""
+
+    def __init__(self, *, poison_ids=(), check_payloads: bool = True):
+        self.poison_ids = set(poison_ids)
+        self.check_payloads = check_payloads
+        self._reqs: dict[str, _ReqRecord] = {}
+        self._kv: dict[str, int] = {}
+        self._violations: list[str] = []
+        self._pending = 0
+        self._brokers: list = []
+
+    # -- wiring ---------------------------------------------------------------
+
+    def attach(self, broker) -> None:
+        """Observe every terminal the broker settles, at settle time.
+        Instance-attribute wrap: the broker's own internal dispositions
+        (reaper dead-letters, failover deadline sheds) flow through it
+        too, which is what makes the observation continuous rather than
+        drain-time-only."""
+        orig = broker.push_response
+
+        def wrapped(resp, _orig=orig):
+            self.observe_response(resp)
+            return _orig(resp)
+
+        broker.push_response = wrapped
+        self._brokers.append(broker)
+
+    # -- per-request lifecycle ------------------------------------------------
+
+    def on_submit(self, req, now: float = 0.0) -> None:
+        rec = self._reqs.get(req.id)
+        if rec is not None:
+            self._violations.append(f"duplicate submit for {req.id}")
+            return
+        rec = _ReqRecord()
+        if self.check_payloads and req.token_ids:
+            rec.expected_last = int(req.token_ids[-1])
+        rec.max_new = req.max_new_tokens
+        rec.slo_class = req.slo_class
+        rec.has_deadline = req.deadline_ts is not None
+        rec.submit_t = now
+        self._reqs[req.id] = rec
+        self._pending += 1
+
+    def on_shed(self, req) -> None:
+        """Brownout 429 at the admission edge: terminal there, must never
+        also be answered by the broker."""
+        rec = _ReqRecord()
+        rec.shed = True
+        if req.id in self._reqs:
+            self._violations.append(f"shed after submit: {req.id}")
+        self._reqs[req.id] = rec
+
+    def on_preempt(self, req_id: str) -> None:
+        rec = self._reqs.get(req_id)
+        if rec is not None:
+            rec.preempts += 1
+
+    # Terminal codes kept instead of response objects: a million-request
+    # storm must not pin a million GenerateResponses in checker memory.
+    T_OK, T_DEADLINE, T_DEADLETTER, T_ERROR = 1, 2, 3, 4
+
+    def observe_response(self, resp) -> None:
+        rec = self._reqs.get(resp.id)
+        if rec is None:
+            # A response for a request the harness never submitted —
+            # invented traffic is as bad as lost traffic.
+            self._violations.append(f"unsolicited response for {resp.id}")
+            return
+        if rec.shed:
+            self._violations.append(
+                f"{resp.id} was shed at admission but also answered"
+            )
+            return
+        if rec.terminal is not None:
+            rec.dups += 1
+            self._violations.append(f"{resp.id} answered twice")
+            return
+        self._pending -= 1
+        if resp.error:
+            if _DEADLETTER_ERR in resp.error:
+                rec.terminal = self.T_DEADLETTER
+                if resp.id not in self.poison_ids:
+                    self._violations.append(
+                        f"{resp.id} dead-lettered but is not poison"
+                        + (
+                            f" (preempted {rec.preempts}x — refund leak)"
+                            if rec.preempts else ""
+                        )
+                    )
+            elif _DEADLINE_ERR in resp.error:
+                rec.terminal = self.T_DEADLINE
+                if not rec.has_deadline:
+                    self._violations.append(
+                        f"{resp.id} deadline-shed but had no deadline"
+                    )
+            else:
+                rec.terminal = self.T_ERROR
+            return
+        rec.terminal = self.T_OK
+        if self.check_payloads and rec.expected_last is not None:
+            from llmss_tpu.serve.chaos import ScriptedEngine
+
+            expect = ScriptedEngine.expected_tokens(
+                [rec.expected_last], rec.max_new,
+            )
+            if resp.token_ids != expect:
+                self._violations.append(f"corrupt payload for {resp.id}")
+
+    # -- KV block accounts ----------------------------------------------------
+
+    def kv_alloc(self, account: str, blocks: int) -> None:
+        self._kv[account] = self._kv.get(account, 0) + blocks
+
+    def kv_free(self, account: str, blocks: int) -> None:
+        left = self._kv.get(account, 0) - blocks
+        if left < 0:
+            self._violations.append(
+                f"kv account {account} went negative ({left})"
+            )
+        self._kv[account] = left
+
+    # -- verdicts -------------------------------------------------------------
+
+    @property
+    def pending(self) -> int:
+        return self._pending
+
+    def stats(self) -> dict:
+        terms = [r for r in self._reqs.values() if not r.shed]
+        return {
+            "submitted": len(terms),
+            "shed": sum(1 for r in self._reqs.values() if r.shed),
+            "answered": sum(1 for r in terms if r.terminal is not None),
+            "ok": sum(1 for r in terms if r.terminal == self.T_OK),
+            "deadline_shed": sum(
+                1 for r in terms if r.terminal == self.T_DEADLINE
+            ),
+            "dead_lettered": sum(
+                1 for r in terms if r.terminal == self.T_DEADLETTER
+            ),
+            "preempted_requests": sum(1 for r in terms if r.preempts),
+        }
+
+    def check_drained(self, broker=None) -> list[str]:
+        """Drain-time sweep: returns ALL violations (continuous ones
+        included). Call once the fleet is idle."""
+        out = list(self._violations)
+        for rid, rec in self._reqs.items():
+            if not rec.shed and rec.terminal is None:
+                out.append(f"request {rid} never answered (lost)")
+        for account, blocks in sorted(self._kv.items()):
+            if blocks != 0:
+                out.append(
+                    f"kv account {account} holds {blocks} blocks at drain"
+                )
+        broker = broker or (self._brokers[0] if self._brokers else None)
+        if broker is not None:
+            dlq_ids = {row["id"] for row in broker.read_dlq(limit=10_000)}
+            bad = dlq_ids - self.poison_ids
+            if bad:
+                out.append(f"non-poison requests in DLQ: {sorted(bad)[:5]}")
+            stats = broker.delivery_stats()
+            if stats.get("inflight") or stats.get("handoff_inflight"):
+                out.append(
+                    "leases still outstanding at drain: "
+                    f"{stats['inflight']} req / "
+                    f"{stats['handoff_inflight']} handoff"
+                )
+        return out
+
+    def assert_ok(self, broker=None) -> None:
+        violations = self.check_drained(broker)
+        if violations:
+            raise InvariantViolation(
+                f"{len(violations)} invariant violation(s):\n  "
+                + "\n  ".join(violations[:20])
+            )
+
+
+# -- wall-clock helpers (threaded chaos tests / chaos_serve parity) -----------
+
+
+def collect_responses(broker, reqs, timeout_s: float,
+                      dup_probe_s: float = 0.2) -> dict:
+    """One waiter thread per request (the producer pattern). Returns
+    ``{id: response | None | "DUPLICATE"}`` — a second response landing
+    within ``dup_probe_s`` of the first marks the id DUPLICATE."""
+    results: dict = {}
+    lock = threading.Lock()
+
+    def wait_one(req):
+        resp = broker.wait_response(req.id, timeout=timeout_s)
+        with lock:
+            results[req.id] = resp
+        if resp is not None:
+            dup = broker.wait_response(req.id, timeout=dup_probe_s)
+            if dup is not None:
+                with lock:
+                    results[req.id] = "DUPLICATE"
+
+    threads = [
+        threading.Thread(target=wait_one, args=(r,), daemon=True)
+        for r in reqs
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout_s + 5)
+    return results
+
+
+def audit_exactly_once(reqs, results, *, broker=None, poison_ids=(),
+                       expected_tokens=None) -> int:
+    """Assert the full invariant catalog over a collected chaos run;
+    returns the success count.
+
+    ``expected_tokens(req) -> list[int]`` defaults to the scripted
+    engine's deterministic payload. ``broker`` enables the DLQ-only-
+    poison and no-leaked-lease checks on top of the per-request
+    contract."""
+    if expected_tokens is None:
+        from llmss_tpu.serve.chaos import ScriptedEngine
+
+        def expected_tokens(r):
+            return ScriptedEngine.expected_tokens(
+                list(r.token_ids), r.max_new_tokens,
+            )
+
+    poison = set(poison_ids)
+    successes = 0
+    for r in reqs:
+        got = results.get(r.id)
+        assert got is not None, f"request {r.id} never answered (lost)"
+        assert got != "DUPLICATE", f"request {r.id} answered twice"
+        if got.error:
+            assert _DEADLETTER_ERR not in got.error or r.id in poison or (
+                not poison
+            ), f"non-poison request {r.id} dead-lettered: {got.error}"
+        else:
+            assert got.token_ids == expected_tokens(r), (
+                f"corrupt payload for {r.id}"
+            )
+            successes += 1
+    if broker is not None:
+        dlq_ids = {row["id"] for row in broker.read_dlq(limit=10_000)}
+        if poison:
+            bad = dlq_ids - poison
+            assert not bad, f"non-poison requests in DLQ: {sorted(bad)[:5]}"
+        stats = broker.delivery_stats()
+        assert stats.get("dlq_depth", 0) == len(dlq_ids)
+    return successes

@@ -1,0 +1,53 @@
+"""Deterministic event loop over a :class:`VirtualClock`.
+
+A binary heap of ``(fire_at, seq, callback)``: ties break on insertion
+order (``seq``), so two events scheduled for the same instant always run
+in the order they were scheduled — the property that makes a whole
+scenario replay byte-identically. Callbacks take no arguments; state
+rides in closures. There is no cancellation primitive: actors carry a
+generation counter and a stale callback returns immediately (a dead
+replica's pending step is a no-op, exactly like a killed process's
+timer never firing anything observable).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Callable
+
+
+class EventLoop:
+    __slots__ = ("clock", "_heap", "_seq", "_stopped")
+
+    def __init__(self, clock):
+        self.clock = clock
+        self._heap: list[tuple[float, int, Callable[[], None]]] = []
+        self._seq = 0
+        self._stopped = False
+
+    def call_at(self, t: float, fn: Callable[[], None]) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap, (max(t, self.clock.now), self._seq, fn))
+
+    def call_after(self, delay_s: float, fn: Callable[[], None]) -> None:
+        self.call_at(self.clock.now + max(0.0, delay_s), fn)
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def run(self, until_s: float | None = None) -> float:
+        """Drain events in time order; returns the final virtual time.
+        ``until_s`` bounds the clock — events scheduled past it stay
+        unfired (the scenario's hard wall)."""
+        self._stopped = False
+        while self._heap and not self._stopped:
+            t, _seq, fn = self._heap[0]
+            if until_s is not None and t > until_s:
+                break
+            heapq.heappop(self._heap)
+            self.clock.advance_to(t)
+            fn()
+        return self.clock.now

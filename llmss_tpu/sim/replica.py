@@ -1,0 +1,565 @@
+"""Simulated replicas: thin actors over the REAL serving primitives.
+
+A :class:`SimReplica` owns no queueing, lease, routing, or disposition
+logic — all of that is the real broker's. What it models is the device:
+virtual seconds per fused step (the :class:`DeviceCostModel`), KV block
+occupancy, and the failure behaviors a real consumer process exhibits
+(dying mid-batch, hanging, fencing itself when it cannot renew leases).
+
+Per work cycle a replica, in order: fences itself if its leases must
+have expired (it could not touch them for longer than the visibility
+timeout — the real consumer's watchdog contract), settles work whose
+compute time elapsed during the PREVIOUS cycle, pops new work through
+``broker.pop_request`` / ``pop_handoff``, preempts via the scheduler's
+REAL victim policy (:func:`select_preemption_victim` +
+``broker.preempt_requests``), advances every active row by one fused
+chunk, and touches its leases. Completions and handoff exports settle
+at the START of the next cycle — after their compute time has actually
+passed on the virtual clock — so a kill landing mid-chunk loses them
+exactly the way a SIGKILL loses an unacked batch, and only the broker's
+visibility timeout can recover the requests.
+
+Roles mirror serve/handoff.py: ``unified`` decodes what it prefills,
+``prefill`` exports a :class:`HandoffRecord` after the prompt (routed
+with the real ``pick_decode_worker``), ``decode`` adopts records off
+the handoff channel. A ``prefill``-role replica still answers
+single-token requests directly, exactly like the real PrefillWorker.
+"""
+
+from __future__ import annotations
+
+import collections
+
+from llmss_tpu.engine.scheduler import select_preemption_victim
+from llmss_tpu.serve.chaos import POISON_TOKEN, ScriptedEngine
+from llmss_tpu.serve.fleet import routable_workers
+from llmss_tpu.serve.handoff import HandoffRecord, pick_decode_worker
+from llmss_tpu.serve.protocol import (
+    SLO_CLASS_RANK,
+    STATE_READY,
+    GenerateResponse,
+    prefix_hash,
+)
+
+# Synthetic handoff payload: the broker counts real record bytes, but
+# carrying megabytes of fake KV through a million-request storm would
+# drown the host; wire cost is priced analytically by the cost model.
+_SIM_PAYLOAD = b"LKVH-sim"
+
+
+class _Row:
+    __slots__ = (
+        "req", "rec", "total_new", "done", "prefill_left", "blocks",
+        "charged", "is_handoff", "first_t", "last_t",
+    )
+
+    def __init__(self, *, req, rec=None, total_new, done, prefill_left,
+                 blocks, is_handoff=False):
+        self.req = req
+        self.rec = rec
+        self.total_new = total_new
+        self.done = done
+        self.prefill_left = prefill_left
+        self.blocks = blocks
+        self.charged = False  # KV blocks taken (admitted rows only)
+        self.is_handoff = is_handoff
+        self.first_t = None
+        self.last_t = None  # last token emission (step-gap metrics)
+
+
+class SimReplica:
+    def __init__(
+        self, sim, wid: str, *, role: str = "unified", rows: int = 8,
+        chunk_tokens: int = 16, prefill_chunk: int = 64,
+        admit_burst: int = 4, heartbeat_s: float = 0.5,
+        retry_s: float = 0.05, cost=None,
+        prefill_mode: str = "chunked", prefix_lru_slots: int = 0,
+        preempt: bool = True, sized_handoff_payload: bool = False,
+    ):
+        self.sim = sim
+        self.wid = wid
+        # Each replica holds its own broker *view*, exactly like a real
+        # consumer process: one shared InProcBroker, or a per-worker
+        # RedisBroker instance over the shared (Fake)Redis — lease keys
+        # embed the worker identity, so sharing one RedisBroker object
+        # between replicas would corrupt lease attribution.
+        self.broker = sim.broker_for(wid)
+        self.role = role
+        self.rows = rows
+        self.chunk_tokens = chunk_tokens
+        self.prefill_chunk = prefill_chunk
+        self.admit_burst = max(1, admit_burst)
+        # "chunked" (default): ragged metered prefill, a few prompt
+        # tokens per fused step. "split": the pre-ragged bucket ladder —
+        # the whole prompt pads to the next power-of-two bucket and runs
+        # inline, stalling co-batched decode, plus a one-time XLA
+        # compile stall the first time a bucket past the prewarmed
+        # ladder is used (bench_ragged's comparison arm).
+        if prefill_mode not in ("chunked", "split"):
+            raise ValueError(f"unknown prefill_mode {prefill_mode!r}")
+        self.prefill_mode = prefill_mode
+        self._compiled_buckets: set[int] = set()
+        # Optional per-replica prefix LRU (bench_router's working-set
+        # model): a resident prefix COW-attaches (prefill skips the
+        # prefix tokens); a miss pays the full prefill and evicts LRU.
+        self.prefix_lru_slots = int(prefix_lru_slots)
+        self._prefix_lru: collections.OrderedDict = collections.OrderedDict()
+        self.preempt = bool(preempt)
+        # Ship KV-sized handoff payloads so the broker's byte counters
+        # reflect real wire volume (PD bench); storms keep the sentinel.
+        self.sized_handoff_payload = bool(sized_handoff_payload)
+        self.heartbeat_s = heartbeat_s
+        self.retry_s = retry_s
+        self.cost = cost or sim.cost
+        self.alive = False
+        self.gen = 0
+        self.stalled_until = 0.0
+        self.active: list[_Row] = []
+        self.pending: collections.deque = collections.deque()
+        # Rows whose chunk completed them last cycle; they settle (the
+        # broker learns about them) at the start of the next one.
+        self._to_finish: list[tuple[_Row, float]] = []
+        self._to_export: list[_Row] = []
+        self.last_touch = 0.0
+        self._last_beat = 0.0
+        self._idle = True
+        self.kv_in_use = 0
+        self.busy_s = 0.0  # virtual chip-seconds of work (utilization)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        self.alive = True
+        self.gen += 1
+        self.last_touch = self.sim.clock.now
+        self.broker.register_worker({
+            "worker_id": self.wid, "model": "sim", "role": self.role,
+        })
+        self._publish()
+        self._schedule_heartbeat(self.gen)
+        self._idle = True
+        self.nudge()
+
+    def kill(self, respawn_after_s: float | None = None) -> None:
+        """Hard kill: in-flight rows, unsettled completions, pending
+        pops, and KV vanish with the process; leases are left to rot —
+        the broker's visibility timeout is the only recovery path (same
+        contract as chaos.HardKill)."""
+        if not self.alive:
+            return
+        self.alive = False
+        self.gen += 1
+        self._drop_all_rows()
+        self.sim.counters["kills"] += 1
+        if respawn_after_s is not None:
+            gen = self.gen
+            self.sim.loop.call_after(respawn_after_s, lambda: (
+                self._respawn() if self.gen == gen else None
+            ))
+
+    def _drop_all_rows(self) -> None:
+        for row in self.active:
+            self._release_blocks(row)
+        for row, _t in self._to_finish:
+            self._release_blocks(row)
+        for row in self._to_export:  # prefill done, blocks still charged
+            self._release_blocks(row)
+        self.active.clear()
+        self.pending.clear()  # never admitted: no blocks charged
+        self._to_finish.clear()
+        self._to_export.clear()
+
+    def _respawn(self) -> None:
+        self.sim.counters["respawns"] += 1
+        self.start()
+
+    def stall(self, duration_s: float) -> None:
+        """Hang (heartbeat stall): no work, no touches, no heartbeats
+        until the deadline — the progress-stamped heartbeat goes stale
+        and the fleet treats the replica as dead while it is merely
+        wedged. On wake the fence logic (not goodwill) decides whether
+        its leases are still its own."""
+        self.stalled_until = max(
+            self.stalled_until, self.sim.clock.now + duration_s,
+        )
+
+    def nudge(self) -> None:
+        """Schedule an immediate work cycle if idle — called by the sim
+        when work lands that this replica could take."""
+        if self.alive and self._idle:
+            self._idle = False
+            gen = self.gen
+            self.sim.loop.call_at(self.sim.clock.now, lambda: self._step(gen))
+
+    # -- fleet plumbing -------------------------------------------------------
+
+    def _snapshot(self) -> dict:
+        free_rows = self.rows - len(self.active)
+        return {
+            "state": STATE_READY,
+            "alive": True,
+            "role": self.role,
+            "rows": self.rows,
+            "inflight_rows": len(self.active),
+            "queue_depth": len(self.pending),
+            "free_slots": max(free_rows, 0),
+            "free_kv_blocks": self.cost.kv_blocks_total - self.kv_in_use,
+            "kv_blocks_total": self.cost.kv_blocks_total,
+            "prefix_hashes": list(self._prefix_lru),
+            "heartbeat_s": self.heartbeat_s,
+            "heartbeat_ts": self.sim.clock.time(),
+        }
+
+    def _publish(self) -> None:
+        self.broker.publish_worker_load(self.wid, self._snapshot())
+        self._last_beat = self.sim.clock.now
+
+    def _schedule_heartbeat(self, gen: int) -> None:
+        def beat():
+            if gen != self.gen or not self.alive:
+                return
+            now = self.sim.clock.now
+            if now >= self.stalled_until and not self.sim.faults.broker_down(
+                self.wid, now,
+            ):
+                self._publish()
+                if self._idle and self.sim.has_work(self):
+                    self.nudge()
+            self.sim.loop.call_after(self.heartbeat_s, beat)
+
+        self.sim.loop.call_after(self.heartbeat_s, beat)
+
+    # -- KV accounting --------------------------------------------------------
+
+    def _take_blocks(self, row: _Row) -> None:
+        if not row.charged:
+            row.charged = True
+            self.kv_in_use += row.blocks
+            self.sim.checker.kv_alloc(self.wid, row.blocks)
+
+    def _release_blocks(self, row: _Row) -> None:
+        if row.charged:
+            row.charged = False
+            self.kv_in_use -= row.blocks
+            self.sim.checker.kv_free(self.wid, row.blocks)
+
+    # -- the work cycle -------------------------------------------------------
+
+    def _step(self, gen: int) -> None:
+        if gen != self.gen or not self.alive:
+            return
+        sim = self.sim
+        now = sim.clock.now
+        if now < self.stalled_until:
+            sim.loop.call_at(self.stalled_until, lambda: self._step(gen))
+            return
+
+        # Fencing: the visibility timeout elapsed since the last
+        # successful lease renewal, so every lease this replica held has
+        # been (or is about to be) reaped and redelivered. Answering now
+        # would double-serve — drop everything and let the redelivery
+        # own the requests. This is the worker-side half of the
+        # visibility-timeout contract.
+        if (now - self.last_touch > self.broker.lease_s) and (
+            self.active or self.pending or self._to_finish
+            or self._to_export
+        ):
+            n = (
+                len(self.active) + len(self.pending)
+                + len(self._to_finish) + len(self._to_export)
+            )
+            self._drop_all_rows()
+            sim.counters["fenced_rows"] += n
+
+        down = sim.faults.broker_down(self.wid, now)
+        busy = 0.0
+        if down:
+            busy += self.retry_s  # transient-error retry backoff
+        else:
+            # Re-announce BEFORE touching any work: the failover sweep
+            # force-expires every lease of a stale-heartbeat worker, fresh
+            # or not, so a consumer resuming from a pause (stall wake,
+            # partition heal) must publish first or the sweep will steal
+            # leases it takes this very cycle and double-serve them. Real
+            # consumers follow the same order: announce, then pop.
+            if now - self._last_beat >= self.heartbeat_s:
+                self._publish()
+            busy += sim.faults.extra_latency(self.wid, now)
+            self._settle(now)
+            busy += self._drain_broker(now)
+            if self.gen != gen or not self.alive:
+                return  # poison crashed us mid-admission
+            self._maybe_preempt()
+            self._admit()
+            busy += self._work(now + busy)
+            self._touch(now)
+            self.busy_s += busy
+
+        if (self.active or self.pending or self._to_finish
+                or self._to_export or down):
+            sim.loop.call_after(
+                max(busy, 1e-4), lambda: self._step(gen)
+            )
+        else:
+            self._idle = True
+
+    def _settle(self, now: float) -> None:
+        """Answer rows whose compute time has fully elapsed, and push
+        handoff records for completed prefills — the settle half of the
+        previous cycle's work, reachable only if the replica survived
+        it."""
+        for row, t_done in self._to_finish:
+            self._finish(row, t_done)
+        self._to_finish.clear()
+        for row in self._to_export:
+            self._export(row)
+        self._to_export.clear()
+
+    def _drain_broker(self, now: float) -> float:
+        """Pop new work while there is capacity. Requests land in
+        ``pending`` (admission may still need to preempt for them);
+        handoff records adopt straight into rows."""
+        sim = self.sim
+        busy = 0.0
+        if self.role == "decode":
+            while len(self.active) < self.rows:
+                rec = self.broker.pop_handoff(timeout=0.0, worker_id=self.wid)
+                if rec is None:
+                    break
+                row = _Row(
+                    req=rec.req, rec=rec, total_new=rec.req.max_new_tokens,
+                    done=1, prefill_left=0,
+                    blocks=self.cost.kv_blocks(rec.n_tokens, 0),
+                    is_handoff=True,
+                )
+                row.first_t = now
+                row.last_t = now
+                self._take_blocks(row)
+                self.active.append(row)
+                busy += self.cost.adopt_s(rec.n_tokens)
+            return busy
+        # Bounded admission per cycle (a continuous batcher admits a few
+        # rows per iteration, not its whole capacity at once). Besides
+        # realism this bounds the crash blast radius: a redelivered
+        # cohort containing a poison request spreads over several cycles,
+        # so its innocent neighbors finish (or at least diverge in
+        # delivery attempts) instead of dying with the poison in
+        # lockstep until the whole cohort dead-letters.
+        capacity = self.rows + 2  # small pending buffer, like a real host
+        burst = self.admit_burst
+        while burst > 0 and len(self.active) + len(self.pending) < capacity:
+            burst -= 1
+            req = self.broker.pop_request(timeout=0.0, worker_id=self.wid)
+            if req is None:
+                break
+            if req.deadline_ts is not None and (
+                sim.clock.time() > req.deadline_ts
+            ):
+                # Worker-side deadline shed before prefill (consumer.py
+                # contract): nobody is waiting, answer terminally.
+                self.broker.push_response(GenerateResponse(
+                    id=req.id,
+                    error="deadline exceeded before completion",
+                ))
+                continue
+            if req.token_ids and POISON_TOKEN in req.token_ids:
+                # Genuine poison: the chip resets and takes the whole
+                # replica down mid-prefill. The lease rots; repeated
+                # deliveries repeat the crash until the broker
+                # dead-letters the request.
+                sim.counters["poison_crashes"] += 1
+                self.kill(respawn_after_s=sim.poison_respawn_s)
+                return busy
+            plen = len(req.token_ids or ()) or 1
+            resumed = len(req.resume_tokens or ())
+            row = _Row(
+                req=req, total_new=req.max_new_tokens, done=resumed,
+                prefill_left=plen + resumed,
+                blocks=self.cost.kv_blocks(plen, req.max_new_tokens),
+            )
+            self.pending.append(row)
+        return busy
+
+    def _maybe_preempt(self) -> None:
+        """The scheduler's admission-blocked preemption, driven by the
+        REAL policy function and the REAL broker refund path. At most
+        one eviction per cycle, mirroring ContinuousBatcher (whose
+        ``_maybe_preempt`` hook evicts at most once per scheduler
+        step — one fused chunk, which is what a replica cycle models)."""
+        if not self.preempt:
+            return
+        if not self.pending or len(self.active) < self.rows:
+            return
+        head = self.pending[0]
+        head_pri = SLO_CLASS_RANK.get(head.req.slo_class, 1)
+        candidates = [
+            (i, SLO_CLASS_RANK.get(row.req.slo_class, 1), row.done)
+            for i, row in enumerate(self.active)
+            # Same evictability rules as ContinuousBatcher._maybe_preempt:
+            # rows still prefilling have no resume point, and adopted
+            # handoff rows would lose their imported KV.
+            if row.prefill_left == 0 and not row.is_handoff and row.done > 0
+        ]
+        victim_i = select_preemption_victim(candidates, head_pri)
+        if victim_i is None:
+            return
+        row = self.active.pop(victim_i)
+        req = row.req
+        emitted = min(row.done, req.max_new_tokens - 1)
+        req.resume_tokens = ScriptedEngine.expected_tokens(
+            list(req.token_ids), emitted,
+        ) or None
+        req.preemptions += 1
+        self._release_blocks(row)
+        self.broker.preempt_requests([req])
+        self.sim.counters["preemptions"] += 1
+        self.sim.checker.on_preempt(req.id)
+
+    def _admit(self) -> None:
+        while self.pending and len(self.active) < self.rows:
+            row = self.pending.popleft()
+            if self.prefix_lru_slots:
+                self._attach_prefix(row)
+            self._take_blocks(row)
+            self.active.append(row)
+
+    def _attach_prefix(self, row: _Row) -> None:
+        """Prefix-cache admission: a resident prefix COW-attaches (the
+        prefill skips its tokens); a miss prefills everything and the
+        prefix becomes resident, evicting least-recently-used."""
+        pref = row.req.prefix_token_ids
+        if not pref:
+            return
+        h = prefix_hash(pref)
+        lru = self._prefix_lru
+        if h in lru:
+            lru.move_to_end(h)
+            self.sim.counters["prefix_hits"] += 1
+            row.prefill_left = max(1, row.prefill_left - len(pref))
+        else:
+            lru[h] = True
+            while len(lru) > self.prefix_lru_slots:
+                lru.popitem(last=False)
+            self.sim.counters["prefix_misses"] += 1
+
+    def _split_prefill_cost(self, row: _Row) -> float:
+        """The pre-ragged admission path: the whole prompt pads to the
+        next power-of-two bucket and prefills inline; a bucket past the
+        prewarmed ladder compiles a fresh executable mid-serve first."""
+        b = 1 << max(row.prefill_left - 1, 0).bit_length()
+        cost = self.cost.prefill_s(b)
+        if b > self.cost.prewarm_max_bucket and (
+            b not in self._compiled_buckets
+        ):
+            self._compiled_buckets.add(b)
+            self.sim.counters["buckets_compiled"] += 1
+            cost += self.cost.bucket_compile_s
+        return cost
+
+    def _work(self, t_start: float) -> float:
+        """One fused chunk across every active row: ragged prompt chunks
+        feed alongside decode steps (or, in ``split`` mode, whole padded
+        prefills run inline), priced by the cost model. Rows that
+        complete are queued to settle next cycle."""
+        if not self.active:
+            return 0.0
+        split = self.prefill_mode == "split"
+        busy = 0.0
+        feeding = 0
+        decoding = 0
+        for row in self.active:
+            if row.prefill_left > 0:
+                if split:
+                    busy += self._split_prefill_cost(row)
+                else:
+                    feeding += min(self.prefill_chunk, row.prefill_left)
+            else:
+                decoding += 1
+        steps = self.chunk_tokens if decoding else 1
+        busy += steps * self.cost.decode_step_s + self.cost.prefill_s(feeding)
+        t_done = t_start + busy
+        gaps = self.sim.step_gaps
+
+        keep: list[_Row] = []
+        for row in self.active:
+            if row.prefill_left > 0:
+                row.prefill_left -= (
+                    row.prefill_left if split
+                    else min(self.prefill_chunk, row.prefill_left)
+                )
+                if row.prefill_left == 0:
+                    if row.done == 0:
+                        row.done = 1
+                    row.first_t = t_done
+                    row.last_t = t_done
+                    if self.role == "prefill" and row.total_new > 1:
+                        self._to_export.append(row)
+                        continue
+            else:
+                row.done = min(row.done + steps, row.total_new)
+                if gaps is not None:
+                    # Inter-token gap for this row, stalls included —
+                    # the decode-cadence variance the PD and ragged
+                    # benches measure. One sample per fused step.
+                    gaps.append(
+                        t_done - (row.last_t if row.last_t is not None
+                                  else t_start)
+                    )
+                row.last_t = t_done
+            if row.done >= row.total_new and row.prefill_left == 0:
+                self._to_finish.append((row, t_done))
+            else:
+                keep.append(row)
+        self.active = keep
+        return busy
+
+    def _export(self, row: _Row) -> None:
+        """Prefill complete on a prefill-role replica: hand the KV off
+        through the real channel; the record IS the request-lease ack."""
+        sim = self.sim
+        req = row.req
+        first = ScriptedEngine.expected_tokens(list(req.token_ids), 1)[0]
+        n_tokens = len(req.token_ids or ()) or 1
+        payload = (
+            bytes(self.cost.handoff_bytes(n_tokens))
+            if self.sized_handoff_payload else _SIM_PAYLOAD
+        )
+        rec = HandoffRecord(
+            req=req, first_token=first, n_tokens=n_tokens, payload=payload,
+        )
+        target = pick_decode_worker(
+            routable_workers(sim.broker, stale_factor=3.0),
+            self.broker.handoff_depths(),
+        )
+        if target is None:
+            self.broker.push_handoff(rec)
+        else:
+            self.broker.push_handoff_to(target, rec)
+        sim.record_first_token(req, row.first_t)
+        self._release_blocks(row)
+        sim.counters["handoffs_pushed"] += 1
+        sim.on_handoff_pushed(target)
+
+    def _finish(self, row: _Row, t_done: float) -> None:
+        req = row.req
+        tokens = ScriptedEngine.expected_tokens(
+            list(req.token_ids), row.total_new,
+        )
+        self.broker.push_response(
+            GenerateResponse(id=req.id, token_ids=tokens)
+        )
+        self._release_blocks(row)
+        if row.first_t is not None:
+            self.sim.record_first_token(req, row.first_t)
+        self.sim.record_done(req, t_done, row.total_new)
+
+    def _touch(self, now: float) -> None:
+        req_ids = [
+            r.req.id for r in self.active if not r.is_handoff
+        ] + [r.req.id for r in self.pending]
+        if req_ids:
+            self.broker.touch_requests(req_ids)
+        hand_ids = [r.req.id for r in self.active if r.is_handoff]
+        if hand_ids:
+            self.broker.touch_handoffs(hand_ids)
+        self.last_touch = now

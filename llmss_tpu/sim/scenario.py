@@ -1,0 +1,732 @@
+"""Scenario files + the FleetSim engine that runs them.
+
+A scenario is a JSON document (``format: "llmss-scenario/1"``,
+docs/simulator.md) describing one deterministic run: broker parameters,
+fleet shape, device cost model, workload (synthetic arrival process or
+an ``llmss-workload/1`` capture from ``/trace/export_workload``), and a
+fault schedule. :class:`FleetSim` instantiates the REAL serving stack —
+``InProcBroker`` or ``RedisBroker``-over-``FakeRedis``, the fleet
+``Router`` + ``BrownoutController``, the handoff channel, the
+scheduler's preemption policy — under a virtual clock, pumps the
+workload through :class:`~llmss_tpu.sim.replica.SimReplica` actors,
+fires the fault schedule, and asserts the full invariant catalog at
+drain.
+
+Determinism rules (docs/simulator.md): one ``random.Random(seed)``
+drives every stochastic choice in a fixed order; the event loop breaks
+time ties by insertion order; no wall-clock value can leak into the run
+(the virtual clock owns ``time.monotonic``/``time.time`` while
+installed, and reports contain only virtual-time quantities). Same
+scenario + same seed ⇒ byte-identical report.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import random
+
+from llmss_tpu.serve.broker import InProcBroker, RedisBroker
+from llmss_tpu.serve.chaos import POISON_TOKEN, FakeRedis
+from llmss_tpu.serve.fleet import BrownoutController, Router
+from llmss_tpu.serve.protocol import (
+    SLO_CLASSES,
+    GenerateRequest,
+)
+from llmss_tpu.sim.clock import VirtualClock
+from llmss_tpu.sim.cost import DeviceCostModel
+from llmss_tpu.sim.faults import FaultPlane
+from llmss_tpu.sim.invariants import InvariantChecker
+from llmss_tpu.sim.loop import EventLoop
+from llmss_tpu.sim.replica import SimReplica
+from llmss_tpu.utils import trace
+
+SCENARIO_FORMAT = "llmss-scenario/1"
+
+_ROLE_PREFIX = {"unified": "u", "prefill": "p", "decode": "d"}
+
+
+def load_scenario(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        spec = json.load(f)
+    fmt = spec.get("format")
+    if fmt != SCENARIO_FORMAT:
+        raise ValueError(
+            f"{path}: format {fmt!r}, expected {SCENARIO_FORMAT!r}"
+        )
+    return spec
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list (no numpy on
+    the hot path; deterministic for byte-identical reports)."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
+    return sorted_vals[i]
+
+
+class FleetSim:
+    """One scenario run over the real serving stack on a virtual clock."""
+
+    def __init__(self, spec: dict, *, n_requests: int | None = None,
+                 duration_s: float | None = None, seed: int | None = None):
+        fmt = spec.get("format", SCENARIO_FORMAT)
+        if fmt != SCENARIO_FORMAT:
+            raise ValueError(f"unsupported scenario format {fmt!r}")
+        self.spec = spec
+        self.name = spec.get("name", "scenario")
+        self.seed = int(spec.get("seed", 0) if seed is None else seed)
+        self.rng = random.Random(self.seed)
+        self.duration_s = (
+            duration_s if duration_s is not None else spec.get("duration_s")
+        )
+
+        self.clock = VirtualClock()
+        self.loop = EventLoop(self.clock)
+        self.cost = DeviceCostModel.from_config(spec.get("cost_model"))
+        self.broker = self._build_broker(spec.get("broker") or {})
+        wl = dict(spec.get("workload") or {})
+        if n_requests is not None:
+            wl["requests"] = n_requests
+        self.workload = wl
+        self.checker = InvariantChecker(
+            check_payloads=bool(wl.get("check_payloads", True)),
+        )
+        self.checker.attach(self.broker)
+        self._attach_collector(self.broker)
+        self.faults = FaultPlane()
+        self.counters: dict[str, int] = collections.defaultdict(int)
+
+        fleet = spec.get("fleet") or {}
+        self.replicas: list[SimReplica] = []
+        self.by_wid: dict[str, SimReplica] = {}
+        self._build_fleet(fleet)
+        # "shared" is the null policy: requests go to the shared queue
+        # and any non-decode replica pops them — the baseline arm the
+        # router benches compare against.
+        policy = fleet.get("router_policy", "least_loaded")
+        self.router = None if policy == "shared" else Router(
+            self.broker,
+            policy=policy,
+            failover_check_s=float(fleet.get("failover_check_s", 1.0)),
+        )
+        self.ctrl = self._build_brownout(fleet.get("brownout"))
+        self.poison_respawn_s = float(spec.get("poison_respawn_s", 0.5))
+        self.tick_s = float(spec.get("control_tick_s", 0.25))
+
+        # Virtual-time latency accounting (successes only).
+        self._submit_t: dict[str, float] = {}
+        self._first_t: dict[str, float] = {}
+        self._ttft: list[float] = []
+        self._e2e: list[float] = []
+        self._interactive_ttft = collections.deque(maxlen=64)
+        self._tokens_out = 0
+        self._done = 0
+        self._arrivals_done = False
+        self._end_t = 0.0
+
+        # Optional metric planes (scenario "metrics" block). step_gaps
+        # collects one inter-token gap per decoding row per fused step —
+        # the cadence-variance measurement the PD/ragged benches assert
+        # on; leave it off for big storms (one float per token).
+        m = spec.get("metrics") or {}
+        self.step_gaps: list[float] | None = (
+            [] if m.get("step_gaps") else None
+        )
+        self.per_class = bool(m.get("per_class"))
+        self._cls_ttft: dict[str, list[float]] = collections.defaultdict(list)
+        self._cls_e2e: dict[str, list[float]] = collections.defaultdict(list)
+        self._cls_offered: dict[str, int] = collections.defaultdict(int)
+        self._cls_done: dict[str, int] = collections.defaultdict(int)
+        self._cls_shed: dict[str, int] = collections.defaultdict(int)
+        # Hook: map a request to its accounting class. Defaults to the
+        # request's slo_class; benches that neutralize broker priority
+        # (the FIFO arm submits everything as one class) install a
+        # side-table classifier so per-class stats keep the true class.
+        self.classify = None
+
+    # -- construction ---------------------------------------------------------
+
+    def _build_broker(self, b: dict):
+        self._broker_kind = b.get("kind", "inproc")
+        self._broker_kw = dict(
+            lease_s=float(b.get("lease_s", 2.0)),
+            max_delivery_attempts=int(b.get("max_delivery_attempts", 5)),
+            worker_ttl_s=float(b.get("worker_ttl_s", 30.0)),
+        )
+        if self._broker_kind == "inproc":
+            return InProcBroker(
+                response_ttl_s=float(b.get("response_ttl_s", 60.0)),
+                **self._broker_kw,
+            )
+        if self._broker_kind == "fakeredis":
+            self._redis_client = FakeRedis()
+            return RedisBroker(
+                client=self._redis_client, worker_id="sim-router",
+                **self._broker_kw,
+            )
+        raise ValueError(f"unknown broker kind {self._broker_kind!r}")
+
+    def broker_for(self, wid: str):
+        """A replica's broker view. InProc: the one shared instance.
+        Redis: a per-worker RedisBroker over the shared (Fake)Redis,
+        like each real consumer process owns — lease keys embed the
+        worker identity and ``pop_request`` adopts the caller's id into
+        the instance, so replicas must not share one object. Every view
+        gets the checker + collector wrap so responses pushed (or
+        dispositioned by a reaper) through ANY view are observed."""
+        if self._broker_kind == "inproc":
+            return self.broker
+        view = RedisBroker(
+            client=self._redis_client, worker_id=wid, **self._broker_kw,
+        )
+        self.checker.attach(view)
+        self._attach_collector(view)
+        return view
+
+    def _build_fleet(self, fleet: dict) -> None:
+        groups = fleet.get("replicas") or [{"count": 4, "role": "unified"}]
+        idx: dict[str, int] = collections.defaultdict(int)
+        for g in groups:
+            role = g.get("role", "unified")
+            prefix = _ROLE_PREFIX[role]
+            for _ in range(int(g.get("count", 1))):
+                wid = f"sim-{prefix}{idx[role]:02d}"
+                idx[role] += 1
+                r = SimReplica(
+                    self, wid, role=role,
+                    rows=int(g.get("rows", 8)),
+                    chunk_tokens=int(g.get("chunk_tokens", 16)),
+                    prefill_chunk=int(g.get("prefill_chunk", 64)),
+                    admit_burst=int(g.get("admit_burst", 4)),
+                    heartbeat_s=float(g.get("heartbeat_s", 0.5)),
+                    prefill_mode=g.get("prefill_mode", "chunked"),
+                    prefix_lru_slots=int(g.get("prefix_lru_slots", 0)),
+                    preempt=bool(g.get("preempt", True)),
+                    sized_handoff_payload=bool(
+                        g.get("sized_handoff_payload", False)
+                    ),
+                )
+                self.replicas.append(r)
+                self.by_wid[wid] = r
+
+    def _build_brownout(self, b: dict | None):
+        if not b:
+            return None
+        target = float(b.get("ttft_target_s", 0.5))
+        burn_mode = b.get("burn", "mean")
+        slo_target = float(b.get("slo_target", 0.95))
+
+        def read_burn() -> float:
+            window = self._interactive_ttft
+            if not window:
+                return 0.0
+            if burn_mode == "attainment":
+                # SLO burn rate: fraction of the error budget
+                # (1 - slo_target) consumed over the sliding window —
+                # the bench_priority ladder driver.
+                att = sum(1 for v in window if v <= target) / len(window)
+                return (1.0 - att) / max(1.0 - slo_target, 1e-9)
+            return sum(window) / len(window) / target
+
+        return BrownoutController(
+            read_burn,
+            high=float(b.get("high", 2.0)),
+            low=float(b.get("low", 1.0)),
+            dwell_s=float(b.get("dwell_s", 5.0)),
+            check_s=float(b.get("check_s", 1.0)),
+            batch_max_new_cap=int(b.get("batch_max_new_cap", 64)),
+        )
+
+    def _attach_collector(self, broker) -> None:
+        """Pop every settled response out of the broker's buffer the
+        instant it lands (the checker wrapper already observed it).
+        Nobody in the sim blocks on wait_response, and push_response's
+        TTL prune scans its whole buffer — keeping the buffer empty is
+        what keeps a million-request storm O(1) per response."""
+        inner = broker.push_response
+
+        def wrapped(resp):
+            inner(resp)
+            broker.wait_response(resp.id, timeout=0.0)
+
+        broker.push_response = wrapped
+
+    # -- hooks SimReplica calls -----------------------------------------------
+
+    def has_work(self, replica: SimReplica) -> bool:
+        if replica.role == "decode":
+            return (
+                self.broker.handoff_depth() > 0
+                or self.broker.handoff_depths().get(replica.wid, 0) > 0
+            )
+        return (
+            self.broker.queue_depth() > 0
+            or self.broker.routed_depths().get(replica.wid, 0) > 0
+        )
+
+    def record_first_token(self, req, t: float) -> None:
+        self._first_t[req.id] = t
+
+    def _class_of(self, req) -> str:
+        return self.classify(req) if self.classify else req.slo_class
+
+    def record_done(self, req, t_done: float, n_tokens: int) -> None:
+        sub = self._submit_t.pop(req.id, None)
+        first = self._first_t.pop(req.id, None)
+        cls = self._class_of(req) if self.per_class else None
+        if sub is not None:
+            if first is not None:
+                ttft = first - sub
+                self._ttft.append(ttft)
+                if req.slo_class == "interactive":
+                    self._interactive_ttft.append(ttft)
+                if cls is not None:
+                    self._cls_ttft[cls].append(ttft)
+            self._e2e.append(t_done - sub)
+            if cls is not None:
+                self._cls_e2e[cls].append(t_done - sub)
+        if cls is not None:
+            self._cls_done[cls] += 1
+        self._tokens_out += n_tokens
+        self._done += 1
+        self._end_t = max(self._end_t, t_done)
+
+    def on_handoff_pushed(self, target: str | None) -> None:
+        r = self.by_wid.get(target) if target else None
+        if r is not None:
+            r.nudge()
+            return
+        for r in self.replicas:
+            if r.role == "decode":
+                r.nudge()
+
+    # -- workload -------------------------------------------------------------
+
+    def _install_workload(self) -> None:
+        wl = self.workload
+        kind = wl.get("kind", "synthetic")
+        if kind == "synthetic":
+            self._install_synthetic(wl)
+        elif kind == "workload-file":
+            self._install_workload_file(wl)
+        elif kind == "trace":
+            self._install_trace(wl)
+        else:
+            raise ValueError(f"unknown workload kind {kind!r}")
+
+    def _install_synthetic(self, wl: dict) -> None:
+        n = int(wl.get("requests", 1000))
+        rate = float(wl.get("rate_rps", 500.0))
+        arrival = wl.get("arrival", "poisson")
+        p_lo, p_hi = wl.get("prompt_len", [4, 32])
+        m_lo, m_hi = wl.get("max_new", [4, 32])
+        classes = wl.get(
+            "classes", {"interactive": 0.2, "standard": 0.6, "batch": 0.2}
+        )
+        cdf: list[tuple[float, str]] = []
+        acc = 0.0
+        for c in SLO_CLASSES:  # fixed order — determinism
+            if c in classes:
+                acc += float(classes[c])
+                cdf.append((acc, c))
+        deadlines = wl.get("deadline_s") or {}
+        poison_every = int(wl.get("poison_every", 0))
+        sessions = int(wl.get("sessions", 0))
+        rng = self.rng
+
+        def make(i: int) -> GenerateRequest:
+            plen = rng.randint(int(p_lo), int(p_hi))
+            ids = [rng.randrange(1, 50_000) for _ in range(plen)]
+            u = rng.random() * acc
+            slo = next((c for a, c in cdf if u <= a), cdf[-1][1])
+            req = GenerateRequest(
+                token_ids=ids,
+                max_new_tokens=rng.randint(int(m_lo), int(m_hi)),
+                slo_class=slo,
+                id=f"s{i:08d}",
+            )
+            if sessions:
+                req.session_id = f"sess-{rng.randrange(sessions):05d}"
+            d = deadlines.get(slo)
+            poison = poison_every and (i + 1) % poison_every == 0
+            if poison:
+                # Genuine poison: crashes every replica that prefills
+                # it. No deadline — exhausting delivery attempts into
+                # the DLQ is the outcome under test.
+                req.token_ids[-1] = POISON_TOKEN
+                self.checker.poison_ids.add(req.id)
+            elif d is not None:
+                req.deadline_ts = self.clock.time() + float(d)
+            return req
+
+        def pump(i: int):
+            self._submit(make(i))
+            if i + 1 < n:
+                if arrival == "uniform":
+                    dt = 1.0 / rate
+                else:
+                    dt = rng.expovariate(rate)
+                self.loop.call_after(dt, lambda: pump(i + 1))
+            else:
+                self._arrivals_done = True
+
+        if n > 0:
+            self.loop.call_at(self.clock.now, lambda: pump(0))
+        else:
+            self._arrivals_done = True
+
+    def _install_workload_file(self, wl: dict) -> None:
+        """Native replay of an ``llmss-workload/1`` capture (PR 11's
+        ``/trace/export_workload``): arrivals, prompt/output lengths,
+        SLO classes, and (when captured) session ids replay verbatim;
+        token values are synthesized deterministically from the seed."""
+        path = wl["path"]
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        if doc.get("format") != "llmss-workload/1":
+            raise ValueError(
+                f"{path}: not an llmss-workload/1 file "
+                f"(format={doc.get('format')!r})"
+            )
+        speedup = float(wl.get("speedup", 1.0))
+        rows = doc.get("requests") or []
+        rng = self.rng
+
+        def make(i: int) -> GenerateRequest:
+            row = rows[i]
+            plen = max(1, int(row.get("prompt_len") or 8))
+            req = GenerateRequest(
+                token_ids=[rng.randrange(1, 50_000) for _ in range(plen)],
+                max_new_tokens=max(1, int(row.get("max_new_tokens") or 16)),
+                slo_class=row.get("slo_class") or "standard",
+                id=row.get("req_id") or f"w{i:08d}",
+            )
+            sess = row.get("session_id")
+            if sess:
+                req.session_id = sess
+            return req
+
+        def pump(i: int):
+            self._submit(make(i))
+            if i + 1 < len(rows):
+                now_off = float(rows[i].get("arrival_s") or 0.0)
+                nxt = float(rows[i + 1].get("arrival_s") or 0.0)
+                self.loop.call_after(
+                    max(0.0, (nxt - now_off) / speedup),
+                    lambda: pump(i + 1),
+                )
+            else:
+                self._arrivals_done = True
+
+        if rows:
+            self.loop.call_at(self.clock.now, lambda: pump(0))
+        else:
+            self._arrivals_done = True
+
+    def _install_trace(self, wl: dict) -> None:
+        """Explicit inline trace: ``rows`` is a list of request dicts
+        (``arrival_s``, ``prompt_len`` or ``token_ids``, ``max_new``,
+        optional ``slo_class``/``prefix_token_ids``/``deadline_s``/
+        ``session_id``/``id``) — the bench tools' deterministic traces,
+        carried inside the scenario instead of a separate capture file."""
+        rows = sorted(
+            wl.get("rows") or [],
+            key=lambda r: float(r.get("arrival_s", 0.0)),
+        )
+        rng = self.rng
+
+        def make(i: int) -> GenerateRequest:
+            row = rows[i]
+            ids = row.get("token_ids")
+            if ids is None:
+                plen = max(1, int(row.get("prompt_len") or 8))
+                ids = [rng.randrange(1, 50_000) for _ in range(plen)]
+            req = GenerateRequest(
+                token_ids=list(ids),
+                max_new_tokens=max(1, int(row.get("max_new") or 16)),
+                slo_class=row.get("slo_class") or "standard",
+                id=str(row.get("id") or f"t{i:08d}"),
+            )
+            pref = row.get("prefix_token_ids")
+            if pref:
+                req.prefix_token_ids = list(pref)
+            if row.get("session_id"):
+                req.session_id = str(row["session_id"])
+            d = row.get("deadline_s")
+            if d is not None:
+                req.deadline_ts = self.clock.time() + float(d)
+            return req
+
+        def pump(i: int):
+            self._submit(make(i))
+            if i + 1 < len(rows):
+                now_off = float(rows[i].get("arrival_s", 0.0))
+                nxt = float(rows[i + 1].get("arrival_s", 0.0))
+                self.loop.call_after(max(0.0, nxt - now_off),
+                                     lambda: pump(i + 1))
+            else:
+                self._arrivals_done = True
+
+        if rows:
+            self.loop.call_at(
+                self.clock.now + float(rows[0].get("arrival_s", 0.0)),
+                lambda: pump(0),
+            )
+        else:
+            self._arrivals_done = True
+
+    def _submit(self, req: GenerateRequest) -> None:
+        now = self.clock.now
+        self.counters["submitted"] += 1
+        if self.per_class:
+            self._cls_offered[self._class_of(req)] += 1
+        if self.ctrl is not None:
+            ok, _retry = self.ctrl.admit(req)
+            if not ok:
+                self.counters["shed"] += 1
+                if self.per_class:
+                    self._cls_shed[self._class_of(req)] += 1
+                self.checker.on_shed(req)
+                return
+        self.checker.on_submit(req, now)
+        self._submit_t[req.id] = now
+        if self.router is None:
+            self.broker.push_request(req)
+            wid = None
+        else:
+            wid = self.router.submit(req)
+        target = self.by_wid.get(wid) if wid else None
+        if target is not None:
+            target.nudge()
+        else:
+            for r in self.replicas:
+                if r.role != "decode":
+                    r.nudge()
+
+    # -- fault schedule -------------------------------------------------------
+
+    def _install_faults(self) -> None:
+        for f in self.spec.get("faults", ()):
+            times = [float(f.get("at_s", 0.0))]
+            every = f.get("repeat_every_s")
+            if every:
+                if not self.duration_s:
+                    raise ValueError(
+                        "repeat_every_s requires scenario duration_s"
+                    )
+                t = times[0] + float(every)
+                while t < self.duration_s:
+                    times.append(t)
+                    t += float(every)
+            for t in times:
+                self._install_fault(dict(f), t)
+
+    def _pick_replicas(self, count, role: str | None,
+                       alive_only: bool) -> list[SimReplica]:
+        pool = [
+            r for r in self.replicas
+            if (role in (None, "any") or r.role == role)
+            and (not alive_only or r.alive)
+        ]
+        if count in (None, "*"):
+            return pool
+        return self.rng.sample(pool, min(int(count), len(pool)))
+
+    def _install_fault(self, f: dict, at_s: float) -> None:
+        kind = f["kind"]
+        role = f.get("role")
+        if kind == "kill_wave":
+            count = int(f.get("count", 1))
+            respawn = f.get("respawn_after_s", 2.0)
+            respawn = None if respawn is None else float(respawn)
+            stagger = float(f.get("stagger_s", 0.0))
+
+            def fire_kill():
+                victims = self._pick_replicas(count, role, alive_only=True)
+                for i, r in enumerate(victims):
+                    self.loop.call_after(
+                        i * stagger,
+                        lambda r=r: r.kill(respawn_after_s=respawn),
+                    )
+
+            self.loop.call_at(at_s, fire_kill)
+        elif kind == "partition":
+            dur = float(f.get("duration_s", 1.0))
+            for r in self._pick_replicas(
+                f.get("targets", 1), role, alive_only=False,
+            ):
+                self.faults.add_partition(r.wid, at_s, at_s + dur)
+                self.counters["partitions"] += 1
+        elif kind == "latency_spike":
+            dur = float(f.get("duration_s", 1.0))
+            extra = float(f.get("extra_s", 0.05))
+            targets = f.get("targets", "*")
+            if targets == "*":
+                self.faults.add_latency("*", at_s, at_s + dur, extra)
+                self.counters["latency_spikes"] += 1
+            else:
+                for r in self._pick_replicas(targets, role, False):
+                    self.faults.add_latency(r.wid, at_s, at_s + dur, extra)
+                    self.counters["latency_spikes"] += 1
+        elif kind == "heartbeat_stall":
+            dur = float(f.get("duration_s", 5.0))
+            count = int(f.get("count", 1))
+
+            def fire_stall():
+                for r in self._pick_replicas(count, role, alive_only=True):
+                    r.stall(dur)
+                    self.counters["heartbeat_stalls"] += 1
+
+            self.loop.call_at(at_s, fire_stall)
+        elif kind == "handoff_storm":
+            # Handoff-mid-kill: kill prefill/decode replicas while
+            # records are in flight — exports die unsent (lease rot →
+            # redelivery) and adopted records die with their importer
+            # (handoff lease rot → re-prefill).
+            count = int(f.get("count", 2))
+            respawn = float(f.get("respawn_after_s", 2.0))
+
+            def fire_storm():
+                pool = [
+                    r for r in self.replicas
+                    if r.alive and r.role in ("prefill", "decode")
+                ]
+                for r in self.rng.sample(pool, min(count, len(pool))):
+                    r.kill(respawn_after_s=respawn)
+
+            self.loop.call_at(at_s, fire_storm)
+        else:
+            raise ValueError(f"unknown fault kind {kind!r}")
+
+    # -- control plane + drain ------------------------------------------------
+
+    def _control_tick(self) -> None:
+        self.broker.reap_expired()
+        if self.router is not None:
+            self.router.check_failover()
+        if self.ctrl is not None:
+            self.ctrl.tick()
+        for r in self.replicas:
+            if r.alive and r._idle and self.has_work(r):
+                r.nudge()
+        if (
+            self._arrivals_done and self.checker.pending == 0
+            and self._quiesced()
+        ):
+            self.loop.stop()
+            return
+        self.loop.call_after(self.tick_s, self._control_tick)
+
+    def _quiesced(self) -> bool:
+        """True when no replica holds any row.
+
+        Even with every request terminal, a replica resuming from a
+        partition or heartbeat stall may still hold rows whose leases
+        were reaped and redelivered while it was away.  Its fence check
+        drops them (releasing their KV blocks) on its next cycle —
+        stopping the loop before that cycle runs would strand the
+        charged blocks and misreport them as an accounting leak.
+        """
+        return all(
+            not r.active and not r.pending
+            and not r._to_finish and not r._to_export
+            for r in self.replicas
+        )
+
+    # -- run ------------------------------------------------------------------
+
+    def run(self) -> dict:
+        was_tracing = trace.enabled()
+        trace.set_enabled(False)
+        try:
+            with self.clock.installed():
+                for r in self.replicas:
+                    r.start()
+                self._install_faults()
+                self._install_workload()
+                self.loop.call_after(self.tick_s, self._control_tick)
+                self.loop.run(until_s=self.duration_s)
+                self.checker.assert_ok(self.broker)
+        finally:
+            trace.set_enabled(was_tracing)
+        return self._report()
+
+    def _report(self) -> dict:
+        ttft = sorted(self._ttft)
+        e2e = sorted(self._e2e)
+        span = self._end_t or self.clock.now
+        stats = self.checker.stats()
+        delivery = self.broker.delivery_stats()
+        out = {
+            "scenario": self.name,
+            "format": SCENARIO_FORMAT,
+            "seed": self.seed,
+            "virtual_s": round(self.clock.now, 6),
+            "requests": {
+                "submitted": self.counters["submitted"],
+                **stats,
+            },
+            "latency_ms": {
+                "ttft_p50": round(_percentile(ttft, 0.50) * 1e3, 6),
+                "ttft_p95": round(_percentile(ttft, 0.95) * 1e3, 6),
+                "ttft_p99": round(_percentile(ttft, 0.99) * 1e3, 6),
+                "e2e_p50": round(_percentile(e2e, 0.50) * 1e3, 6),
+                "e2e_p95": round(_percentile(e2e, 0.95) * 1e3, 6),
+            },
+            "throughput": {
+                "tokens_out": self._tokens_out,
+                "tokens_per_s": round(self._tokens_out / span, 6)
+                if span > 0 else 0.0,
+                "requests_per_s": round(self._done / span, 6)
+                if span > 0 else 0.0,
+            },
+            "faults": {
+                k: self.counters[k] for k in sorted(self.counters)
+                if k not in ("submitted", "shed")
+            },
+            "delivery": {
+                k: delivery[k] for k in sorted(delivery)
+                if isinstance(delivery[k], (int, float))
+            },
+            "brownout": (
+                self.ctrl.state()["state"] if self.ctrl is not None else None
+            ),
+            "invariants": {
+                "checked": True,
+                "violations": 0,
+                "pending_at_drain": self.checker.pending,
+            },
+            "cost_model": self.cost.describe(),
+        }
+        if self.per_class:
+            out["classes"] = {
+                cls: {
+                    "offered": self._cls_offered[cls],
+                    "completed": self._cls_done[cls],
+                    "shed": self._cls_shed[cls],
+                    "ttft_p50_ms": round(_percentile(
+                        sorted(self._cls_ttft[cls]), 0.50) * 1e3, 6),
+                    "ttft_p95_ms": round(_percentile(
+                        sorted(self._cls_ttft[cls]), 0.95) * 1e3, 6),
+                    "ttft_p99_ms": round(_percentile(
+                        sorted(self._cls_ttft[cls]), 0.99) * 1e3, 6),
+                }
+                for cls in sorted(self._cls_offered)
+            }
+        return out
+
+
+def run_scenario(spec_or_path, *, n_requests: int | None = None,
+                 duration_s: float | None = None,
+                 seed: int | None = None) -> dict:
+    """Load (if given a path), run, invariant-check, and report."""
+    spec = (
+        load_scenario(spec_or_path)
+        if isinstance(spec_or_path, str) else spec_or_path
+    )
+    sim = FleetSim(
+        spec, n_requests=n_requests, duration_s=duration_s, seed=seed,
+    )
+    return sim.run()

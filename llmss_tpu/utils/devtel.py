@@ -292,6 +292,27 @@ class EngineCostModel:
         )
         return flops, nbytes
 
+    @property
+    def kv_bytes_per_token(self) -> float:
+        """KV bytes one context position costs one row (k + v across
+        layers) — the unit the fleet simulator prices handoff wire
+        transfers and paged-block budgets in."""
+        return self._kv_bytes_row_ctx
+
+
+def roofline_seconds(
+    flops: float, hbm_bytes: float,
+    peak_flops: float, peak_hbm_bps: float,
+) -> float:
+    """Roofline execution time: the kernel runs at whichever ceiling it
+    hits first, so its duration is the max of compute time and memory
+    time. Shared by the MFU/MBU plane's inverse (achieved/peak) and the
+    fleet simulator's cost model, so sim seconds and telemetry
+    utilization are two views of one model."""
+    compute = flops / peak_flops if peak_flops > 0 else 0.0
+    memory = hbm_bytes / peak_hbm_bps if peak_hbm_bps > 0 else 0.0
+    return max(compute, memory)
+
 
 def param_stats(params) -> tuple[int, int]:
     """(element count, bytes) over a params pytree — shape/dtype metadata

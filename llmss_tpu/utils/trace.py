@@ -571,14 +571,19 @@ def export_workload(exports) -> dict:
         if enq is None:
             continue
         a = enq.get("attrs") or {}
-        rows.append({
+        row = {
             "req_id": rid,
             "_arrival_ts": _ts(enq),
             "prompt_len": a.get("plen"),
             "max_new_tokens": a.get("max_new"),
             "prefix_hash": a.get("prefix"),
             "slo_class": a.get("slo_class"),
-        })
+        }
+        # Optional key (absent in captures that predate session ids) so
+        # legacy workload files stay byte-for-byte reproducible.
+        if a.get("session"):
+            row["session_id"] = a["session"]
+        rows.append(row)
     rows.sort(key=lambda r: r["_arrival_ts"])
     t0 = rows[0]["_arrival_ts"] if rows else 0.0
     for r in rows:

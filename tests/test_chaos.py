@@ -9,7 +9,6 @@ at-least-once promise. ``ScriptedEngine`` makes every successful payload
 exactly predictable, so the audit can also catch corruption.
 """
 
-import threading
 import time
 
 import pytest
@@ -21,49 +20,14 @@ from llmss_tpu.serve.chaos import (
 from llmss_tpu.serve.consumer import Worker
 from llmss_tpu.serve.producer import ProducerServer
 from llmss_tpu.serve.protocol import GenerateRequest
+from llmss_tpu.sim.invariants import audit_exactly_once, collect_responses
 
 
-def _collect(broker, reqs, timeout_s):
-    """One waiter per request (the producer pattern). Returns
-    {id: response|None|'DUPLICATE'}."""
-    results = {}
-    lock = threading.Lock()
-
-    def wait_one(req):
-        resp = broker.wait_response(req.id, timeout=timeout_s)
-        with lock:
-            results[req.id] = resp
-        if resp is not None:
-            dup = broker.wait_response(req.id, timeout=0.2)
-            if dup is not None:
-                with lock:
-                    results[req.id] = "DUPLICATE"
-
-    threads = [
-        threading.Thread(target=wait_one, args=(r,), daemon=True)
-        for r in reqs
-    ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout=timeout_s + 5)
-    return results
-
-
-def _audit(reqs, results):
-    """Assert the terminal-response contract over a chaos run."""
-    successes = 0
-    for r in reqs:
-        got = results.get(r.id)
-        assert got is not None, f"request {r.id} never answered (lost)"
-        assert got != "DUPLICATE", f"request {r.id} answered twice"
-        if not got.error:
-            expect = ScriptedEngine.expected_tokens(
-                list(r.token_ids), r.max_new_tokens
-            )
-            assert got.token_ids == expect, f"corrupt payload for {r.id}"
-            successes += 1
-    return successes
+# Collection and the exactly-once audit are the shared sim/serve helpers:
+# the fleet simulator's invariant catalog and these wall-clock chaos tests
+# must enforce the same contract, so they literally share the code.
+_collect = collect_responses
+_audit = audit_exactly_once
 
 
 def _run_fleet(make_worker_broker, producer_broker, n_requests=24,

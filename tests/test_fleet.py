@@ -29,6 +29,7 @@ from llmss_tpu.serve.fleet import (
 )
 from llmss_tpu.serve.handoff import HandoffRecord
 from llmss_tpu.serve.producer import ProducerServer, evaluate_fleet_health
+from llmss_tpu.sim.invariants import audit_exactly_once, collect_responses
 from llmss_tpu.serve.protocol import (
     STATE_DEAD,
     STATE_READY,
@@ -534,31 +535,9 @@ def test_scheduler_load_snapshot_is_host_only():
 # -- multi-replica chaos ----------------------------------------------------
 
 
-def _collect(broker, reqs, timeout_s):
-    """One waiter per request (the producer pattern). Returns
-    {id: response|None|'DUPLICATE'}."""
-    results = {}
-    lock = threading.Lock()
-
-    def wait_one(r):
-        resp = broker.wait_response(r.id, timeout=timeout_s)
-        with lock:
-            results[r.id] = resp
-        if resp is not None:
-            dup = broker.wait_response(r.id, timeout=0.2)
-            if dup is not None:
-                with lock:
-                    results[r.id] = "DUPLICATE"
-
-    threads = [
-        threading.Thread(target=wait_one, args=(r,), daemon=True)
-        for r in reqs
-    ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout=timeout_s + 5)
-    return results
+# Shared with the fleet simulator's invariant catalog (sim/invariants):
+# wall-clock chaos and virtual-clock storms audit the same contract.
+_collect = collect_responses
 
 
 @pytest.mark.parametrize("kind", BROKER_KINDS)
@@ -625,14 +604,9 @@ def test_fleet_chaos_kill_mid_decode(kind):
     assert not [h.error for h in harness.hosts.values() if h.error]
     assert harness.hosts["w0"].kills == 1
     assert harness.hosts["w0"].spawns == 1  # the machine stayed dead
-    for r in reqs:
-        got = results.get(r.id)
-        assert got is not None, f"request {r.id} never answered (lost)"
-        assert got != "DUPLICATE", f"request {r.id} answered twice"
-        assert not got.error, f"terminal error for {r.id}: {got.error}"
-        assert got.token_ids == ScriptedEngine.expected_tokens(
-            list(r.token_ids), r.max_new_tokens
-        ), f"corrupt payload for {r.id}"
+    # == len(reqs): exactly-once AND zero terminal errors — a kill with
+    # failover may not cost any request its clean payload.
+    assert audit_exactly_once(reqs, results) == len(reqs)
     # The stranded routed work was rescued by failover, not luck.
     assert router.stats()["failover_reroutes"] >= len(stranded)
     assert producer.delivery_stats()["failover_rerouted"] >= len(stranded)

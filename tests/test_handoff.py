@@ -41,6 +41,7 @@ from llmss_tpu.serve.protocol import (
     GenerateRequest,
     GenerateResponse,
 )
+from llmss_tpu.sim.invariants import audit_exactly_once, collect_responses
 
 BROKER_KINDS = ("inproc", "fakeredis")
 
@@ -379,17 +380,10 @@ def test_chaos_kill_prefill_mid_handoff_exactly_one_terminal(kind):
     try:
         for r in reqs:
             b.push_request(r)
-        for r in reqs:
-            resp = b.wait_response(r.id, timeout=20.0)
-            assert resp is not None, f"lost {r.id}"
-            assert resp.error is None, (r.id, resp.error)
-            assert resp.token_ids == ScriptedEngine.expected_tokens(
-                list(r.token_ids), r.max_new_tokens,
-            ), r.id
-            # A double answer would park a second response under the id.
-            assert b.wait_response(r.id, timeout=0.05) is None, (
-                f"duplicate terminal response for {r.id}"
-            )
+        # Shared sim/serve audit: exactly one terminal response per
+        # request, clean scripted payloads, zero errors (== len(reqs)).
+        results = collect_responses(b, reqs, timeout_s=20.0)
+        assert audit_exactly_once(reqs, results) == len(reqs)
     finally:
         pre.stop()
         dec.stop()

@@ -32,6 +32,7 @@ from llmss_tpu.serve.chaos import FakeRedis, ScriptedEngine
 from llmss_tpu.serve.consumer import ContinuousWorker, Worker
 from llmss_tpu.serve.fleet import BrownoutController, interactive_burn
 from llmss_tpu.serve.producer import ProducerServer, admission_verdict
+from llmss_tpu.sim.invariants import audit_exactly_once, collect_responses
 from llmss_tpu.serve.protocol import (
     SLO_CLASS_BATCH,
     SLO_CLASS_INTERACTIVE,
@@ -404,15 +405,17 @@ def test_continuous_worker_preempt_roundtrip(dense_engine):
             is_greedy=True, slo_class=SLO_CLASS_INTERACTIVE,
         )
         broker.push_request(hi)
-        resp_hi = broker.wait_response("hi", timeout=60)
-        resp_low = broker.wait_response("low", timeout=60)
+        results = collect_responses(broker, [hi, low], timeout_s=60.0)
     finally:
         stop.set()
         t.join(timeout=10)
-    assert resp_hi is not None and resp_hi.error is None
-    assert resp_hi.token_ids == exp_hi
-    assert resp_low is not None and resp_low.error is None
-    assert resp_low.token_ids == exp_low
+    # Shared sim/serve audit against the real engine's solo greedy
+    # streams: both answered exactly once, preemption did not perturb
+    # a single token.
+    exp = {"hi": exp_hi, "low": exp_low}
+    assert audit_exactly_once(
+        [hi, low], results, expected_tokens=lambda r: exp[r.id],
+    ) == 2
     assert broker.delivery_stats()["preempted"] >= 1
     assert broker.dlq_depth() == 0
 

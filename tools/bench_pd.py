@@ -11,13 +11,18 @@ paged blocks through the broker handoff channel; the decode chip's only
 non-step work is adopting a payload (an HBM-bandwidth block import, ~3
 orders of magnitude cheaper than a long prefill).
 
-The chip is simulated — a cost model charges ``PREFILL_TOKEN_COST_S``
-per prompt token, ``DECODE_STEP_COST_S`` per fused step, and payload
-bytes over ``HBM_GBPS`` for an adopt — but the TRANSFER PLANE IS REAL:
-records ride ``InProcBroker`` push_handoff/pop_handoff/push_response
-with full-size payloads (``KV_BYTES_PER_TOKEN`` defaults to the 1b2
-dims in bf16), leases touched per decode step, so handoff bytes per
-request and the delivery counters come from the broker, not the model.
+Both arms run on the deterministic fleet simulator (``llmss_tpu.sim``):
+the chip is a :class:`DeviceCostModel` charging
+``PREFILL_TOKEN_COST_S`` per prompt token, ``DECODE_STEP_COST_S`` per
+fused step, and payload bytes over ``HBM_GBPS`` for an adopt — but the
+TRANSFER PLANE IS REAL: records ride the broker's
+push_handoff/pop_handoff/push_response with full-size payloads
+(``KV_BYTES_PER_TOKEN`` defaults to the 1b2 dims in bf16), leases
+touched per cycle, so handoff bytes per request and the delivery
+counters come from the broker, not the model — and the sim's invariant
+catalog (exactly-one-terminal, KV balance, …) is asserted at drain.
+Virtual clock: the run is byte-reproducible and takes milliseconds of
+wall time regardless of the simulated seconds.
 
 Runs on CPU in one process (no JAX, no device). Writes PD_BENCH.json;
 prints one JSON line. Asserts the structural claims the subsystem ships
@@ -32,17 +37,10 @@ import json
 import os
 import statistics
 import sys
-import threading
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from llmss_tpu.serve.broker import InProcBroker  # noqa: E402
-from llmss_tpu.serve.handoff import HandoffRecord  # noqa: E402
-from llmss_tpu.serve.protocol import (  # noqa: E402
-    GenerateRequest,
-    GenerateResponse,
-)
+from llmss_tpu.sim import FleetSim  # noqa: E402
 
 N_CHIPS = 2  # both fleets: 2 unified vs 1 prefill + 1 decode
 ROWS = int(os.environ.get("PD_ROWS", 8))  # decode rows per chip
@@ -64,180 +62,18 @@ KV_BYTES_PER_TOKEN = int(
 )
 
 
-class _Recorder:
-    """Shared per-mode measurement state (one per run_mode call)."""
-
-    def __init__(self):
-        self.lock = threading.Lock()
-        self.submit_ts: dict[str, float] = {}
-        self.ttfts: list[float] = []  # guarded_by: self.lock
-        self.gaps: list[float] = []  # inter-token s  guarded_by: self.lock
-        self.tokens = 0  # guarded_by: self.lock
-
-    def first_token(self, rid: str) -> None:
-        with self.lock:
-            self.ttfts.append(time.monotonic() - self.submit_ts[rid])
-            self.tokens += 1
-
-    def step(self, rows: list[dict], now: float) -> None:
-        """One fused decode step landed: every active row gained a token;
-        the gap since ITS last token (prefill/adopt stalls included — that
-        is the variance being measured) goes into the pool."""
-        with self.lock:
-            for row in rows:
-                self.gaps.append(now - row["last_t"])
-                row["last_t"] = now
-                self.tokens += 1
-
-
-class _SimWorker:
-    """Thread shell: subclasses implement one scheduler iteration."""
-
-    def __init__(self, wid: str, broker, rec: _Recorder):
-        self.wid = wid
-        self.broker = broker
-        self.rec = rec
-        self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        broker.register_worker({"worker_id": self.wid, "role": self.role})
-
-    def _loop(self):
-        while not self._stop.is_set():
-            self.iterate()
-
-    def start(self):
-        self._thread.start()
-
-    def stop(self):
-        self._stop.set()
-        self._thread.join(timeout=10)
-
-
-class UnifiedSim(_SimWorker):
-    """Continuous batching on one chip: admit, prefill INLINE (stalling
-    the fused decode loop — the head-of-line cost disaggregation
-    removes), then step all active rows."""
-
-    role = "unified"
-
-    def __init__(self, *a):
-        super().__init__(*a)
-        self.active: list[dict] = []
-
-    def iterate(self):
-        req = None
-        if len(self.active) < ROWS:
-            req = self.broker.pop_request(
-                timeout=0.0 if self.active else 0.005, worker_id=self.wid,
-            )
-        if req is not None:
-            time.sleep(PREFILL_TOKEN_COST_S * len(req.token_ids or []))
-            self.rec.first_token(req.id)
-            if req.max_new_tokens <= 1:
-                self.broker.push_response(GenerateResponse(
-                    id=req.id, token_ids=[0][: req.max_new_tokens],
-                ))
-                return
-            self.active.append({
-                "id": req.id, "left": req.max_new_tokens - 1,
-                "last_t": time.monotonic(),
-            })
-        if not self.active:
-            return
-        time.sleep(DECODE_STEP_COST_S)
-        now = time.monotonic()
-        self.rec.step(self.active, now)
-        done = [r for r in self.active if r["left"] <= 1]
-        self.active = [r for r in self.active if r["left"] > 1]
-        for r in self.active:
-            r["left"] -= 1
-        for r in done:
-            self.broker.push_response(GenerateResponse(
-                id=r["id"], token_ids=[0],  # sim: count, not content
-            ))
-
-
-class PrefillSim(_SimWorker):
-    """Prefill-only chip: pop, charge the prefill, ship the full-size
-    payload through the REAL broker handoff channel."""
-
-    role = "prefill"
-
-    def iterate(self):
-        req = self.broker.pop_request(timeout=0.005, worker_id=self.wid)
-        if req is None:
-            return
-        n = len(req.token_ids or [])
-        time.sleep(PREFILL_TOKEN_COST_S * n)
-        self.rec.first_token(req.id)
-        if req.max_new_tokens <= 1:
-            self.broker.push_response(GenerateResponse(
-                id=req.id, token_ids=[0][: req.max_new_tokens],
-            ))
-            return
-        self.broker.push_handoff(HandoffRecord(
-            req=req, first_token=0, n_tokens=n,
-            payload=bytes(n * KV_BYTES_PER_TOKEN),
-        ))
-
-
-class DecodeSim(_SimWorker):
-    """Decode-only chip: adopt handoffs (HBM import cost, leases renewed
-    per fused step) and run the same batched step loop as UnifiedSim —
-    minus the inline prefills."""
-
-    role = "decode"
-
-    def __init__(self, *a):
-        super().__init__(*a)
-        self.active: list[dict] = []
-
-    def iterate(self):
-        rec = None
-        if len(self.active) < ROWS:
-            rec = self.broker.pop_handoff(
-                timeout=0.0 if self.active else 0.005, worker_id=self.wid,
-            )
-        if rec is not None:
-            time.sleep(
-                ADOPT_CONST_S + len(rec.payload) / (HBM_GBPS * 1e9)
-            )
-            self.active.append({
-                "id": rec.req.id, "left": rec.req.max_new_tokens - 1,
-                "last_t": time.monotonic(),
-            })
-        if not self.active:
-            return
-        time.sleep(DECODE_STEP_COST_S)
-        now = time.monotonic()
-        self.rec.step(self.active, now)
-        self.broker.touch_handoffs([r["id"] for r in self.active])
-        done = [r for r in self.active if r["left"] <= 1]
-        self.active = [r for r in self.active if r["left"] > 1]
-        for r in self.active:
-            r["left"] -= 1
-        for r in done:  # push_response acks the handoff lease
-            self.broker.push_response(GenerateResponse(
-                id=r["id"], token_ids=[0],
-            ))
-
-
-def make_trace() -> list[GenerateRequest]:
+def make_trace_rows() -> list[dict]:
     """Mixed trace, interleaved so long prefills keep landing while
     short interactive rows are mid-decode."""
     longs = [
-        GenerateRequest(
-            token_ids=[1000 + i] * LONG_PROMPT, max_new_tokens=LONG_NEW,
-        )
+        {"token_ids": [1000 + i] * LONG_PROMPT, "max_new": LONG_NEW}
         for i in range(N_LONG)
     ]
     shorts = [
-        GenerateRequest(
-            token_ids=[2000 + i] * SHORT_PROMPT, max_new_tokens=SHORT_NEW,
-        )
+        {"token_ids": [2000 + i] * SHORT_PROMPT, "max_new": SHORT_NEW}
         for i in range(N_SHORT)
     ]
-    out: list[GenerateRequest] = []
+    out: list[dict] = []
     ratio = max(1, N_SHORT // max(N_LONG, 1))
     while longs or shorts:
         if longs:
@@ -245,67 +81,86 @@ def make_trace() -> list[GenerateRequest]:
         for _ in range(ratio):
             if shorts:
                 out.append(shorts.pop(0))
+    for i, row in enumerate(out):
+        row["id"] = f"pd{i:04d}"
+        row["arrival_s"] = i * ARRIVAL_GAP_S
     return out
 
 
-def run_mode(mode: str) -> dict:
-    broker = InProcBroker()
-    rec = _Recorder()
+def make_spec(mode: str) -> dict:
+    # prefill_chunk covers the whole prompt: the unified arm prefills
+    # INLINE in one fused step, stalling co-batched decode — the
+    # head-of-line cost disaggregation removes. chunk_tokens=1 so every
+    # decode step is one gap sample.
+    inline = max(LONG_PROMPT, SHORT_PROMPT)
+    common = {
+        "rows": ROWS, "chunk_tokens": 1, "prefill_chunk": inline,
+        "admit_burst": 1,
+    }
     if mode == "unified":
-        workers = [
-            UnifiedSim(f"u{i}", broker, rec) for i in range(N_CHIPS)
-        ]
+        replicas = [{"count": N_CHIPS, "role": "unified", **common}]
     else:
-        workers = [
-            PrefillSim("prefill0", broker, rec),
-            DecodeSim("decode0", broker, rec),
+        replicas = [
+            {"count": 1, "role": "prefill", **common,
+             "sized_handoff_payload": True},
+            {"count": 1, "role": "decode", **common},
         ]
-    reqs = make_trace()
-    for w in workers:
-        w.start()
-    t0 = time.monotonic()
-    for r in reqs:
-        rec.submit_ts[r.id] = time.monotonic()
-        broker.push_request(r)
-        time.sleep(ARRIVAL_GAP_S)
-    lost = errored = 0
-    for r in reqs:
-        resp = broker.wait_response(r.id, timeout=60.0)
-        if resp is None:
-            lost += 1
-        elif resp.error:
-            errored += 1
-    elapsed = time.monotonic() - t0
-    for w in workers:
-        w.stop()
-    stats = broker.delivery_stats()
-    gaps_ms = [g * 1e3 for g in rec.gaps]
-    out = {
+    return {
+        "format": "llmss-scenario/1",
+        "name": f"bench-pd-{mode}",
+        "seed": 0,
+        "broker": {"kind": "inproc", "lease_s": 5.0},
+        "cost_model": {
+            "kind": "table",
+            "prefill_token_s": PREFILL_TOKEN_COST_S,
+            "decode_step_s": DECODE_STEP_COST_S,
+            "adopt_const_s": ADOPT_CONST_S,
+            "kv_bytes_per_token": KV_BYTES_PER_TOKEN,
+            "wire_gbps": HBM_GBPS,
+        },
+        "fleet": {"replicas": replicas, "router_policy": "shared"},
+        "workload": {"kind": "trace", "rows": make_trace_rows()},
+        "metrics": {"step_gaps": True},
+    }
+
+
+def run_mode(mode: str) -> dict:
+    sim = FleetSim(make_spec(mode))
+    report = sim.run()
+    r = report["requests"]
+    tp = report["throughput"]
+    # Virtual span from submit of the first request to the last
+    # completion (recover it from the rounded rate rather than the
+    # drain-padded clock).
+    elapsed = (
+        tp["tokens_out"] / tp["tokens_per_s"] if tp["tokens_per_s"] else 0.0
+    )
+    delivery = report["delivery"]
+    gaps_ms = [g * 1e3 for g in sim.step_gaps]
+    return {
         "mode": mode,
-        "requests": len(reqs),
-        "lost": lost,
-        "errored": errored,
-        "tokens": rec.tokens,
-        "tok_s_chip": round(rec.tokens / elapsed / N_CHIPS, 1),
-        "ttft_p50_ms": round(statistics.median(rec.ttfts) * 1e3, 3),
-        "ttft_p95_ms": round(
-            statistics.quantiles(rec.ttfts, n=20)[18] * 1e3, 3
-        ),
+        "requests": r["submitted"],
+        "lost": r["submitted"] - r["answered"],
+        "errored": r["answered"] - r["ok"],
+        "tokens": tp["tokens_out"],
+        "tok_s_chip": round(tp["tokens_out"] / elapsed / N_CHIPS, 1)
+        if elapsed else 0.0,
+        "ttft_p50_ms": round(report["latency_ms"]["ttft_p50"], 3),
+        "ttft_p95_ms": round(report["latency_ms"]["ttft_p95"], 3),
         "decode_step_ms_mean": round(statistics.fmean(gaps_ms), 3),
         "decode_step_ms_stdev": round(statistics.stdev(gaps_ms), 3),
         "decode_step_ms_p95": round(
             statistics.quantiles(gaps_ms, n=20)[18], 3
         ),
-        "handoffs": stats.get("handoffs", 0),
-        "handoff_bytes": stats.get("handoff_bytes", 0),
+        "handoffs": delivery.get("handoffs", 0),
+        "handoff_bytes": delivery.get("handoff_bytes", 0),
         "handoff_bytes_per_request": (
-            round(stats["handoff_bytes"] / stats["handoffs"])
-            if stats.get("handoffs") else 0
+            round(delivery["handoff_bytes"] / delivery["handoffs"])
+            if delivery.get("handoffs") else 0
         ),
-        "reprefills": stats.get("reprefills", 0),
+        "reprefills": delivery.get("reprefills", 0),
         "elapsed_s": round(elapsed, 3),
     }
-    return out
 
 
 def main():

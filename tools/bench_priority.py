@@ -2,40 +2,49 @@
 
 Replays two heavy-tailed traces (the ROADMAP mixed-tenant scenario: a
 steady drip of long batch jobs, moderate standard traffic, interactive
-arrivals in tight bursts) through three scheduling arms of a
-deterministic virtual-time simulator of one continuous-batching worker,
-and reports per-class TTFT p95/p99, SLO attainment, and a
-chips-equivalent figure. The **burst** trace is recoverable overload —
-the FIFO-vs-tiered p95 headline, where a quiet brownout controller is
-itself the asserted behaviour. The **overload** trace is sustained
-demand beyond capacity, where priorities alone cannot save interactive
-and the degradation-ordering claims (batch before standard before
-interactive, interactive never shed) are asserted on real shed counts.
+arrivals in tight bursts) through three scheduling arms of the
+deterministic fleet simulator (``llmss_tpu.sim``) — one continuous-
+batching replica over the REAL broker, scheduler preemption policy, and
+``BrownoutController`` — and reports per-class TTFT p95/p99, SLO
+attainment, and a chips-equivalent figure. The **burst** trace is
+recoverable overload — the FIFO-vs-tiered p95 headline, where the
+brownout ladder sheds background work so bursts land on free rows
+instead of paying the one-eviction-per-cycle train. The **overload**
+trace is sustained demand beyond capacity, where priorities alone
+cannot save interactive and the degradation-ordering claims (batch
+before standard before interactive, interactive never shed) are
+asserted on real shed counts.
 
 The arms:
 
-- ``fifo``     — one class-blind queue, no preemption, admit-all. The
-  static-fleet baseline: interactive bursts queue behind batch rows.
+- ``fifo``     — one class-blind queue, no preemption, admit-all: every
+  request submits as one SLO class (the broker's class queues collapse
+  to FIFO) and a side-table classifier keeps per-class accounting
+  honest. The static-fleet baseline: interactive bursts queue behind
+  batch rows.
 - ``tiered``   — class-priority queues + paged-KV preemption: an
   interactive arrival blocked on row capacity evicts the lowest-class
-  running row (scheduler ``_maybe_preempt`` semantics: victim strictly
-  outranked, fewest emitted tokens; refund to the head of its class
-  queue; resume replays the emitted prefix).
+  running row (the scheduler's REAL ``select_preemption_victim``:
+  victim strictly outranked, fewest emitted tokens; refund to the head
+  of its class queue; resume replays the emitted prefix).
 - ``brownout`` — tiered plus the real ``BrownoutController`` driven by
-  the interactive burn rate over the sim's sliding TTFT window, walking
-  the cap-batch -> shed-batch -> shed-standard ladder.
+  the interactive SLO burn rate over the sim's sliding TTFT window,
+  walking the cap-batch -> shed-batch -> shed-standard ladder.
 
-The simulator advances in decode-chunk ticks (every resident row emits
-one token per tick); admission charges prompt prefill before the first
-token, and a resumed row re-charges prefill over prompt+emitted — the
-same cost shape the scheduler's chunked-replay resume pays. Virtual
-time makes the bench exactly reproducible: no sleeps, no wall-clock.
+The simulator advances in decode-step cycles (every resident row emits
+one token per fused step); prompt prefill is metered through the ragged
+chunk path before the first token, and a resumed row re-charges prefill
+over prompt+emitted — the same cost shape the scheduler's
+chunked-replay resume pays. Virtual time makes the bench exactly
+reproducible: no sleeps, no wall-clock — and the sim's invariant
+catalog (exactly-one-terminal, preemption refunds never consume
+delivery attempts, KV balance) is asserted at drain of every arm.
 
 ``chips_equivalent`` is the static-fleet cost of buying the same
-interactive TTFT p95 without priorities: the smallest N such that the
-arm meets the interactive target with every rate scaled N× (N
-data-parallel replicas). FIFO needs several chips; the tiered arms hit
-the target on one — that delta is the PR's capacity claim.
+interactive TTFT p95 without priorities: the smallest N data-parallel
+replicas at which the arm meets the interactive target. FIFO needs
+several chips; the tiered arms hit the target on one — that delta is
+the PR's capacity claim.
 
 Also times the scheduler's real ``_maybe_preempt`` no-op paths (idle,
 and pending-but-not-blocked) on a live ContinuousBatcher — the per-step
@@ -52,30 +61,28 @@ import os
 import random
 import sys
 import time
-from collections import deque
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import bench_provenance  # noqa: E402
-from llmss_tpu.serve.fleet import BrownoutController  # noqa: E402
 from llmss_tpu.serve.protocol import (  # noqa: E402
     SLO_CLASS_BATCH,
     SLO_CLASS_INTERACTIVE,
     SLO_CLASS_STANDARD,
-    SLO_CLASS_RANK,
 )
+from llmss_tpu.sim import FleetSim  # noqa: E402
 
 SEED = 1405
 ROWS = 12
-STEP_S = 0.02  # one decode chunk: every resident row advances one token
-#: Admission and eviction happen at scheduler-step (group) boundaries —
-#: the group_chunks saturation configuration. One eviction per group is
-#: the _maybe_preempt bound; this serialization is exactly the latency
-#: brownout sidesteps by keeping rows free BEFORE the burst lands.
-GROUP_TICKS = 4
+STEP_S = 0.02  # one fused decode step: every resident row advances one token
+#: Tokens per fused chunk — the scheduling quantum: admission, eviction
+#: (one per cycle, the ContinuousBatcher bound), and row-freeing happen
+#: once per CHUNK_TOKENS steps, so the quantum sets the eviction-train
+#: latency an interactive burst pays when rows are pinned by batch.
+CHUNK_TOKENS = 2
 PREFILL_TOKEN_S = 0.0004
+PREFILL_CHUNK = 64  # ragged metering: prompt tokens per row per step
 TRACE_S = 120.0
-BURN_WINDOW_S = 20.0
 
 #: per-class TTFT targets (ms) at p95 — mirrors DEFAULT_SLO_OBJECTIVES.
 TTFT_TARGET_MS = {
@@ -141,183 +148,61 @@ def build_trace(overload: bool = False) -> list[dict]:
             })
     reqs.sort(key=lambda r: r["arrival"])
     for i, r in enumerate(reqs):
-        r["id"] = i
+        r["id"] = f"pr{i:05d}"
     return reqs
 
 
-class _Row:
-    __slots__ = ("req", "first_ready", "emitted")
-
-    def __init__(self, req, now, pf_s):
-        self.req = req
-        # prefill (prompt + any replayed resume tokens) completes before
-        # the first new token — a resumed row re-pays the replay.
-        self.first_ready = (
-            now + (req["plen"] + req.get("emitted", 0)) * pf_s
-        )
-        self.emitted = req.get("emitted", 0)
-
-
-def simulate(arm: str, trace: list[dict], speed: float = 1.0) -> dict:
-    """Run one arm over the trace at ``speed``× service rate (N chips
-    data-parallel); returns per-class latency/attainment stats."""
-    step_s = STEP_S / speed
-    pf_s = PREFILL_TOKEN_S / speed
-    queues = {c: deque() for c in CLASSES}
-    fifo_q: deque = deque()
-    active: list[_Row] = []
-    ttft: dict[str, list[float]] = {c: [] for c in CLASSES}
-    e2e: dict[str, list[float]] = {c: [] for c in CLASSES}
-    shed = {c: 0 for c in CLASSES}
-    offered = {c: 0 for c in CLASSES}
-    preemptions = 0
-    busy_s = 0.0
-    burn_samples: deque = deque()  # (t, ttft_s) for interactive finishes
-
-    ctrl = None
-    if arm == "brownout":
-        def read_burn():
-            if not burn_samples:
-                return 0.0
-            ok = sum(
-                1 for _, v in burn_samples
-                if v * 1e3 <= TTFT_TARGET_MS[SLO_CLASS_INTERACTIVE]
-            )
-            att = ok / len(burn_samples)
-            return (1.0 - att) / (1.0 - SLO_TARGET)
-
-        ctrl = BrownoutController(
-            read_burn, high=2.0, low=1.0, dwell_s=4.0, check_s=0.5,
-        )
-
-    def tick_ctrl(now):
-        # Drive the ladder on virtual time, then gate the real-time tick
-        # inside any later admit() so the rung stays the virtual one.
-        ctrl._next_check = 0.0
-        ctrl.tick(now=now)
-        ctrl._next_check = float("inf")
-
-    def pop_next():
-        if arm == "fifo":
-            return fifo_q.popleft() if fifo_q else None
-        for c in CLASSES:
-            if queues[c]:
-                return queues[c].popleft()
-        return None
-
-    def peek_rank():
-        if arm == "fifo":
-            return None
-        for c in CLASSES:
-            if queues[c]:
-                return SLO_CLASS_RANK[c]
-        return None
-
-    pending = deque(trace)
-    t = 0.0
-    k = 0  # tick counter: every GROUP_TICKS-th tick is a group boundary
-    while pending or fifo_q or any(queues.values()) or active:
-        t += step_s
-        k += 1
-        boundary = k % GROUP_TICKS == 0
-        if ctrl is not None:
-            tick_ctrl(t)
-        # arrivals
-        while pending and pending[0]["arrival"] <= t:
-            req = dict(pending.popleft())
-            offered[req["cls"]] += 1
-            if ctrl is not None:
-                # the real admission ladder, on a protocol-shaped stub
-                shim = _AdmitShim(req["cls"], req["max_new"])
-                ok, _retry = ctrl.admit(shim)
-                if not ok:
-                    shed[req["cls"]] += 1
-                    continue
-                req["max_new"] = shim.max_new_tokens
-            (fifo_q if arm == "fifo" else queues[req["cls"]]).append(req)
-        # preemption — group boundaries only, ONE eviction per boundary
-        # (the scheduler's _maybe_preempt bound): head-of-queue strictly
-        # outranks a running row and admission is blocked on rows
-        if boundary and arm != "fifo" and len(active) >= ROWS:
-            head_rank = peek_rank()
-            if head_rank is not None:
-                victim = None
-                for row in active:
-                    r_rank = SLO_CLASS_RANK[row.req["cls"]]
-                    if r_rank <= head_rank or row.emitted == 0:
-                        continue
-                    if victim is None or (
-                        (r_rank, -row.emitted)
-                        > (SLO_CLASS_RANK[victim.req["cls"]],
-                           -victim.emitted)
-                    ):
-                        victim = row
-                if victim is not None:
-                    active.remove(victim)
-                    req = victim.req
-                    req["emitted"] = victim.emitted  # resume point
-                    queues[req["cls"]].appendleft(req)  # head-of-class
-                    preemptions += 1
-        # admission into free rows — also quantized to group boundaries
-        # (rows freed mid-group wait for the next step, like the real
-        # one-group-lag decode loop)
-        while boundary and len(active) < ROWS:
-            req = pop_next()
-            if req is None:
-                break
-            active.append(_Row(req, t, pf_s))
-        # one decode chunk
-        if active:
-            busy_s += step_s
-        for row in list(active):
-            if row.first_ready > t:
-                continue
-            if row.emitted == 0 and "ttft" not in row.req:
-                # resumed rows keep their original first-admission TTFT
-                row.req["ttft"] = t - row.req["arrival"]
-                ttft[row.req["cls"]].append(row.req["ttft"])
-                if row.req["cls"] == SLO_CLASS_INTERACTIVE:
-                    burn_samples.append((t, row.req["ttft"]))
-            row.emitted += 1
-            if row.emitted >= row.req["max_new"]:
-                active.remove(row)
-                e2e[row.req["cls"]].append(t - row.req["arrival"])
-        while burn_samples and burn_samples[0][0] < t - BURN_WINDOW_S:
-            burn_samples.popleft()
-
-    out = {"classes": {}, "preemptions": preemptions,
-           "chip_busy_s": round(busy_s, 1)}
-    for c in CLASSES:
-        tgt = TTFT_TARGET_MS[c]
-        vals = ttft[c]
-        within = sum(1 for v in vals if v * 1e3 <= tgt)
-        out["classes"][c] = {
-            "offered": offered[c],
-            "completed": len(e2e[c]),
-            "shed": shed[c],
-            "ttft_p50_ms": _pct(vals, 0.50),
-            "ttft_p95_ms": _pct(vals, 0.95),
-            "ttft_p99_ms": _pct(vals, 0.99),
-            "ttft_target_ms": tgt,
-            # attainment over OFFERED traffic: a shed request is a
-            # degraded request — brownout can't launder its sheds out of
-            # the denominator.
-            "slo_attainment": round(within / offered[c], 4)
-            if offered[c] else None,
+def make_spec(arm: str, trace: list[dict], chips: int) -> dict:
+    rows = [
+        {
+            "id": r["id"],
+            "arrival_s": r["arrival"],
+            "prompt_len": r["plen"],
+            "max_new": r["max_new"],
+            # The FIFO arm is class-blind: everything rides one queue.
+            "slo_class": (
+                SLO_CLASS_STANDARD if arm == "fifo" else r["cls"]
+            ),
         }
-    if ctrl is not None:
-        out["brownout"] = ctrl.state()
-    return out
-
-
-class _AdmitShim:
-    """Just enough of GenerateRequest for BrownoutController.admit."""
-
-    __slots__ = ("slo_class", "max_new_tokens")
-
-    def __init__(self, cls, max_new):
-        self.slo_class = cls
-        self.max_new_tokens = max_new
+        for r in trace
+    ]
+    spec = {
+        "format": "llmss-scenario/1",
+        "name": f"bench-priority-{arm}-{chips}",
+        "seed": SEED,
+        # Long-prompt admission cycles can run past a short visibility
+        # timeout; the bench measures scheduling, not lease churn.
+        "broker": {"kind": "inproc", "lease_s": 30.0},
+        "cost_model": {
+            "kind": "table",
+            "prefill_token_s": PREFILL_TOKEN_S,
+            "decode_step_s": STEP_S,
+        },
+        "fleet": {
+            "replicas": [{
+                "count": chips, "role": "unified", "rows": ROWS,
+                "chunk_tokens": CHUNK_TOKENS, "prefill_chunk": PREFILL_CHUNK,
+                "admit_burst": ROWS, "preempt": arm != "fifo",
+            }],
+            "router_policy": "shared",
+        },
+        "workload": {"kind": "trace", "rows": rows},
+        "metrics": {"per_class": True},
+    }
+    if arm == "brownout":
+        spec["fleet"]["brownout"] = {
+            "ttft_target_s": TTFT_TARGET_MS[SLO_CLASS_INTERACTIVE] / 1e3,
+            "burn": "attainment", "slo_target": SLO_TARGET,
+            # ``low=0`` latches rungs for the trace duration (the
+            # controller de-escalates on ``burn < low``, strict): every
+            # de-escalation re-admits the batch backlog into rows, and
+            # the next burst pays a one-eviction-per-cycle train to
+            # clear it — on a 2-minute trace with bursts every 8 s the
+            # flap costs more interactive attainment than any batch
+            # throughput it buys back.
+            "high": 1.0, "low": 0.0, "dwell_s": 6.0, "check_s": 0.5,
+        }
+    return spec
 
 
 def _pct(vals, q) -> float | None:
@@ -328,12 +213,51 @@ def _pct(vals, q) -> float | None:
     return round(s[i] * 1e3, 1)
 
 
+def simulate(arm: str, trace: list[dict], chips: int = 1) -> dict:
+    """Run one arm over the trace on a ``chips``-replica data-parallel
+    fleet; returns per-class latency/attainment stats."""
+    sim = FleetSim(make_spec(arm, trace, chips))
+    true_cls = {r["id"]: r["cls"] for r in trace}
+    # Per-class accounting keeps the TRUE class even in the class-blind
+    # FIFO arm (everything submits as one class there).
+    sim.classify = lambda req: true_cls[req.id]
+    sim.run()
+
+    out = {
+        "classes": {},
+        "preemptions": sim.counters["preemptions"],
+        "chip_busy_s": round(sum(r.busy_s for r in sim.replicas), 1),
+    }
+    for c in CLASSES:
+        tgt = TTFT_TARGET_MS[c]
+        vals = sim._cls_ttft[c]  # per-class TTFT samples (true class)
+        offered = sim._cls_offered[c]
+        within = sum(1 for v in vals if v * 1e3 <= tgt)
+        out["classes"][c] = {
+            "offered": offered,
+            "completed": sim._cls_done[c],
+            "shed": sim._cls_shed[c],
+            "ttft_p50_ms": _pct(vals, 0.50),
+            "ttft_p95_ms": _pct(vals, 0.95),
+            "ttft_p99_ms": _pct(vals, 0.99),
+            "ttft_target_ms": tgt,
+            # attainment over OFFERED traffic: a shed request is a
+            # degraded request — brownout can't launder its sheds out of
+            # the denominator.
+            "slo_attainment": round(within / offered, 4)
+            if offered else None,
+        }
+    if sim.ctrl is not None:
+        out["brownout"] = sim.ctrl.state()
+    return out
+
+
 def chips_equivalent(arm: str, trace: list[dict]) -> int | None:
     """Smallest static N-chip fleet at which ``arm`` meets the
     interactive TTFT p95 target; None if > MAX_CHIPS."""
     tgt = TTFT_TARGET_MS[SLO_CLASS_INTERACTIVE]
     for n in range(1, MAX_CHIPS + 1):
-        r = simulate(arm, trace, speed=float(n))
+        r = simulate(arm, trace, chips=n)
         p95 = r["classes"][SLO_CLASS_INTERACTIVE]["ttft_p95_ms"]
         if p95 is not None and p95 <= tgt:
             return n
@@ -398,9 +322,10 @@ def preempt_hook_microbench() -> dict:
 
 def main() -> int:
     # Scenario 1 — bursty-but-recoverable: the p95 headline. Tiered
-    # scheduling absorbs what FIFO cannot; brownout stays on the ladder's
-    # bottom rung (nothing needs shedding — that is itself a property
-    # worth pinning: the controller is quiet when capacity suffices).
+    # scheduling absorbs what FIFO cannot; brownout additionally keeps
+    # rows free BEFORE each burst lands (shed batch/standard instead of
+    # paying the eviction train), which is what buys the p95 target on
+    # one chip.
     burst_trace = build_trace()
     burst = {}
     for arm in ("fifo", "tiered", "brownout"):
@@ -470,7 +395,8 @@ def main() -> int:
         "provenance": bench_provenance(),
         "config": {
             "seed": SEED, "rows": ROWS, "step_s": STEP_S,
-            "group_ticks": GROUP_TICKS,
+            "chunk_tokens": CHUNK_TOKENS,
+            "prefill_chunk": PREFILL_CHUNK,
             "prefill_token_s": PREFILL_TOKEN_S, "trace_s": TRACE_S,
             "n_requests_burst": len(burst_trace),
             "n_requests_overload": len(over_trace),
@@ -479,6 +405,7 @@ def main() -> int:
         "scenarios": {"burst": burst, "overload": over},
         "preempt_hook": micro,
         "checks": checks,
+        "checks_passed": sum(1 for v in checks.values() if v),
         "ok": all(checks.values()),
     }
     path = os.path.join(

@@ -11,18 +11,21 @@ prompt as extra query rows of the decode dispatch: up to ``CHUNK_BUDGET``
 prompt tokens per row per step, so prefill compute is metered across
 steps and no bucket (or its compile) exists at all.
 
-The chip is simulated — a virtual-clock cost model charges
-``PREFILL_TOKEN_COST_S`` per prompt token (padded to the bucket on the
-split path, metered per chunk on the ragged path),
-``DECODE_STEP_COST_S`` per fused step, and ``BUCKET_COMPILE_S`` once per
-bucket beyond the prewarmed ladder — so the comparison is deterministic
-and free of host noise; the scheduler arithmetic (admission, chunk
-metering, head-of-line stalls) is the thing being measured. Runs on CPU
-in one process (no JAX, no device). Writes RAGGED_BENCH.json; prints one
-JSON line. Asserts the claims the subsystem ships on: decode step-time
-stdev no worse on the all-decode trace (the ragged program is not
-allowed to tax the steady state) and materially lower TTFT p95 plus
-lower decode stdev on the mixed long-prompt trace.
+Both arms run on the deterministic fleet simulator (``llmss_tpu.sim``):
+one unified replica whose ``prefill_mode`` selects the path (``split`` =
+bucket ladder + mid-serve compile, ``chunked`` = ragged metering with
+``prefill_chunk = CHUNK_BUDGET``), priced by a :class:`DeviceCostModel`
+charging ``PREFILL_TOKEN_COST_S`` per prompt token, ``DECODE_STEP_COST_S``
+per fused step, and ``BUCKET_COMPILE_S`` once per bucket beyond the
+prewarmed ladder — so the comparison is deterministic and free of host
+noise; the scheduler arithmetic (admission, chunk metering, head-of-line
+stalls) is the thing being measured, and requests ride the REAL broker
+with the invariant catalog asserted at drain. Runs on CPU in one process
+(no JAX, no device). Writes RAGGED_BENCH.json; prints one JSON line.
+Asserts the claims the subsystem ships on: decode step-time stdev no
+worse on the all-decode trace (the ragged program is not allowed to tax
+the steady state) and materially lower TTFT p95 plus lower decode stdev
+on the mixed long-prompt trace.
 """
 
 from __future__ import annotations
@@ -33,6 +36,8 @@ import statistics
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from llmss_tpu.sim import FleetSim  # noqa: E402
 
 ROWS = int(os.environ.get("RAGGED_ROWS", 8))
 CHUNK_BUDGET = int(os.environ.get("RAGGED_CB", 16))
@@ -54,10 +59,6 @@ BUCKET_COMPILE_S = float(os.environ.get("RAGGED_BUCKET_COMPILE_S", 2.5))
 PREWARM_MAX_BUCKET = int(os.environ.get("RAGGED_PREWARM_MAX", 128))
 
 
-def _bucket(plen: int) -> int:
-    return 1 << max(plen - 1, 0).bit_length()
-
-
 def make_trace(long_prompt: int, n_long: int) -> list[dict]:
     """Mixed trace, interleaved so long prefills keep landing while short
     interactive rows are mid-decode. ``n_long == 0`` gives the all-decode
@@ -76,100 +77,68 @@ def make_trace(long_prompt: int, n_long: int) -> list[dict]:
         for _ in range(ratio):
             if shorts:
                 out.append(shorts.pop(0))
-    for i, r in enumerate(out):
-        r["id"] = i
-        r["arrival"] = i * ARRIVAL_GAP_S
-    return out
+    return [
+        {
+            "id": f"rg{i:04d}",
+            "arrival_s": i * ARRIVAL_GAP_S,
+            "token_ids": [3000 + i] * r["plen"],
+            "max_new": r["new"],
+        }
+        for i, r in enumerate(out)
+    ]
+
+
+def make_spec(mode: str, rows: list[dict]) -> dict:
+    return {
+        "format": "llmss-scenario/1",
+        "name": f"bench-ragged-{mode}",
+        "seed": 0,
+        "broker": {"kind": "inproc", "lease_s": 10.0},
+        "cost_model": {
+            "kind": "table",
+            "prefill_token_s": PREFILL_TOKEN_COST_S,
+            "decode_step_s": DECODE_STEP_COST_S,
+            "bucket_compile_s": BUCKET_COMPILE_S,
+            "prewarm_max_bucket": PREWARM_MAX_BUCKET,
+        },
+        "fleet": {
+            "replicas": [{
+                "count": 1, "role": "unified", "rows": ROWS,
+                "chunk_tokens": 1, "admit_burst": ROWS,
+                "prefill_mode": "split" if mode == "split" else "chunked",
+                "prefill_chunk": CHUNK_BUDGET,
+            }],
+            "router_policy": "shared",
+        },
+        "workload": {"kind": "trace", "rows": rows},
+        "metrics": {"step_gaps": True},
+    }
 
 
 def run_mode(mode: str, trace: list[dict]) -> dict:
-    """Virtual-clock scheduler loop: one iteration = admit (split: inline
-    padded prefill + possible bucket compile; ragged: free — the prompt
-    becomes a feeding row) then one fused decode step whose cost carries
-    the ragged rows' metered chunk tokens."""
-    queue = sorted(trace, key=lambda r: r["arrival"])
-    active: list[dict] = []
-    compiled_buckets: set[int] = set()
-    now = 0.0
-    ttfts: list[float] = []
-    gaps: list[float] = []  # per-row inter-token s, stalls included
-    tokens = 0
-    qi = 0
-
-    while qi < len(queue) or active:
-        # -- admission --------------------------------------------------
-        while qi < len(queue) and len(active) < ROWS \
-                and queue[qi]["arrival"] <= now:
-            req = queue[qi]
-            qi += 1
-            if mode == "split":
-                b = _bucket(req["plen"])
-                if b > PREWARM_MAX_BUCKET and b not in compiled_buckets:
-                    now += BUCKET_COMPILE_S  # mid-serve XLA compile stall
-                    compiled_buckets.add(b)
-                now += b * PREFILL_TOKEN_COST_S  # padded inline prefill
-                ttfts.append(now - req["arrival"])
-                tokens += 1
-                active.append({
-                    "left": req["new"] - 1, "fed": req["plen"],
-                    "plen": req["plen"], "arrival": req["arrival"],
-                    "last_t": now,
-                })
-            else:
-                active.append({
-                    "left": req["new"], "fed": 0, "plen": req["plen"],
-                    "arrival": req["arrival"], "last_t": now,
-                })
-        if not active:
-            if qi < len(queue):
-                now = max(now, queue[qi]["arrival"])
-            continue
-
-        # -- one fused step ---------------------------------------------
-        fed_this_step = 0
-        feeding = []
-        for r in active:
-            if r["fed"] < r["plen"]:
-                q = min(CHUNK_BUDGET, r["plen"] - r["fed"])
-                r["fed"] += q
-                fed_this_step += q
-                feeding.append(r)
-        now += DECODE_STEP_COST_S + fed_this_step * PREFILL_TOKEN_COST_S
-        for r in feeding:
-            if r["fed"] >= r["plen"]:  # final chunk emits the first token
-                ttfts.append(now - r["arrival"])
-                tokens += 1
-                r["left"] -= 1
-                r["last_t"] = now
-        done = []
-        for r in active:
-            if r["fed"] < r["plen"] or r in feeding:
-                continue
-            gaps.append(now - r["last_t"])
-            r["last_t"] = now
-            tokens += 1
-            r["left"] -= 1
-            if r["left"] <= 0:
-                done.append(r)
-        active = [r for r in active if r not in done and r["left"] > 0]
-
-    gaps_ms = [g * 1e3 for g in gaps]
+    sim = FleetSim(make_spec(mode, trace))
+    report = sim.run()
+    tp = report["throughput"]
+    elapsed = (
+        tp["tokens_out"] / tp["tokens_per_s"] if tp["tokens_per_s"] else 0.0
+    )
+    ttfts = report["latency_ms"]
+    gaps_ms = [g * 1e3 for g in sim.step_gaps]
     return {
         "mode": mode,
         "requests": len(trace),
-        "tokens": tokens,
-        "elapsed_s": round(now, 3),
-        "tok_s_chip": round(tokens / now, 1),
-        "ttft_p50_ms": round(statistics.median(ttfts) * 1e3, 3),
-        "ttft_p95_ms": round(
-            statistics.quantiles(ttfts, n=20)[18] * 1e3, 3
-        ),
+        "tokens": tp["tokens_out"],
+        "elapsed_s": round(elapsed, 3),
+        "tok_s_chip": round(tp["tokens_out"] / elapsed, 1)
+        if elapsed else 0.0,
+        "ttft_p50_ms": round(ttfts["ttft_p50"], 3),
+        "ttft_p95_ms": round(ttfts["ttft_p95"], 3),
         "decode_step_ms_mean": round(statistics.fmean(gaps_ms), 3),
         "decode_step_ms_stdev": round(statistics.stdev(gaps_ms), 3),
         "decode_step_ms_p95": round(
             statistics.quantiles(gaps_ms, n=20)[18], 3
         ),
-        "buckets_compiled_mid_serve": len(compiled_buckets),
+        "buckets_compiled_mid_serve": sim.counters["buckets_compiled"],
     }
 
 

@@ -1,42 +1,38 @@
-"""Router bench: shared queue vs prefix-affinity routing, 3 in-proc workers.
+"""Router bench: shared queue vs prefix-affinity routing, 3 sim workers.
 
 The workload is the one the ``prefix_affinity`` policy exists for: many
 tenants, each with its own shared system prompt, interleaved so that
 consecutive requests almost never share a prefix. Each simulated worker
 holds a small prefix LRU (``LRU_SLOTS`` per worker — fewer than the
 tenant count, more than tenants/worker), and a prefill that misses the
-LRU costs ``MISS_COST_S`` vs ``HIT_COST_S`` on a hit — the same shape as
-a real paged-KV COW prefix hit vs a full prefill.
+LRU pays the full prompt (``MISS_COST_S``) while a hit COW-attaches the
+resident prefix and pays only the suffix — the same shape as a real
+paged-KV COW prefix hit vs a full prefill.
 
 With the shared queue every worker eventually sees every tenant and the
 LRUs thrash; with prefix-affinity each tenant's requests ride to one
-owning replica, so the fleet-wide working set fits. The bench measures
-the worker-observed prefix hit rate, p50/p95 TTFT, and aggregate
-tokens/s for both modes and asserts the direction of the result.
+owning replica, so the fleet-wide working set fits. Both arms run on
+the deterministic fleet simulator (``llmss_tpu.sim``): the REAL
+``Router`` routes (or the ``shared`` null policy pushes to the shared
+queue), replicas publish their resident prefix hashes in fleet
+snapshots, and the invariant catalog is asserted at drain. The bench
+measures the worker-observed prefix hit rate, p50/p95 TTFT, and
+aggregate tokens/s for both modes and asserts the direction of the
+result.
 
-Runs on CPU in one process (``InProcBroker``; no JAX, no device).
-Writes ROUTER_BENCH.json; prints one JSON line.
+Runs on CPU in one process (no JAX, no device). Writes
+ROUTER_BENCH.json; prints one JSON line.
 """
 
 from __future__ import annotations
 
-import collections
 import json
 import os
-import statistics
 import sys
-import threading
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from llmss_tpu.serve.broker import InProcBroker  # noqa: E402
-from llmss_tpu.serve.fleet import Router  # noqa: E402
-from llmss_tpu.serve.protocol import (  # noqa: E402
-    GenerateRequest,
-    GenerateResponse,
-    prefix_hash,
-)
+from llmss_tpu.sim import FleetSim  # noqa: E402
 
 N_WORKERS = int(os.environ.get("ROUTER_WORKERS", 3))
 N_TENANTS = int(os.environ.get("ROUTER_TENANTS", 8))
@@ -49,136 +45,72 @@ MAX_NEW = 16
 PREFIX_LEN = 32
 
 
-class SimWorker:
-    """One replica: pops requests, charges prefill cost by prefix-LRU
-    hit/miss, publishes fleet snapshots with its resident hashes."""
-
-    def __init__(self, wid, broker, submit_ts, ttfts, hits, misses, lock):
-        self.wid = wid
-        self.broker = broker
-        self.submit_ts = submit_ts
-        self.ttfts = ttfts
-        self.hits = hits
-        self.misses = misses
-        self.lock = lock
-        self.lru = collections.OrderedDict()
-        self.tokens_done = 0
-        self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-
-    def _snapshot(self):
-        return {
-            "state": "ready",
-            "alive": True,
-            "rows": 1,
-            "inflight_rows": 0,
-            "queue_depth": 0,
-            "free_slots": 1,
-            "free_kv_blocks": LRU_SLOTS - len(self.lru),
-            "kv_blocks_total": LRU_SLOTS,
-            "prefix_hashes": list(self.lru),
-            "heartbeat_s": 0.5,
-            "heartbeat_ts": time.time(),
-        }
-
-    def _loop(self):
-        self.broker.register_worker({"worker_id": self.wid, "model": "sim"})
-        self.broker.publish_worker_load(self.wid, self._snapshot())
-        while not self._stop.is_set():
-            req = self.broker.pop_request(timeout=0.05, worker_id=self.wid)
-            if req is None:
-                continue
-            h = prefix_hash(req.prefix_token_ids)
-            if h in self.lru:
-                self.lru.move_to_end(h)
-                cost, bucket = HIT_COST_S, self.hits
-            else:
-                self.lru[h] = True
-                while len(self.lru) > LRU_SLOTS:
-                    self.lru.popitem(last=False)
-                cost, bucket = MISS_COST_S, self.misses
-            time.sleep(cost)  # prefill: full on miss, COW-attach on hit
-            with self.lock:
-                bucket.append(req.id)
-                self.ttfts.append(time.monotonic() - self.submit_ts[req.id])
-            time.sleep(TOKEN_COST_S * req.max_new_tokens)
-            self.tokens_done += req.max_new_tokens
-            self.broker.push_response(
-                GenerateResponse(id=req.id, token_ids=[0] * req.max_new_tokens)
-            )
-            self.broker.publish_worker_load(self.wid, self._snapshot())
-
-    def start(self):
-        self._thread.start()
-
-    def stop(self):
-        self._stop.set()
-        self._thread.join(timeout=10)
-
-
-def make_trace():
+def make_trace_rows() -> list[dict]:
     """Interleaved multi-tenant trace: request i belongs to tenant
     i % N_TENANTS, so back-to-back requests never share a prefix."""
     prefixes = [
         [1000 + t] * PREFIX_LEN for t in range(N_TENANTS)
     ]
     return [
-        GenerateRequest(
-            token_ids=prefixes[i % N_TENANTS] + [i + 1],
-            prefix_token_ids=prefixes[i % N_TENANTS],
-            max_new_tokens=MAX_NEW,
-        )
+        {
+            "id": f"rt{i:04d}",
+            "arrival_s": 0.0,  # burst submit, like the original bench
+            "token_ids": prefixes[i % N_TENANTS] + [i + 1],
+            "prefix_token_ids": prefixes[i % N_TENANTS],
+            "max_new": MAX_NEW,
+        }
         for i in range(N_REQUESTS)
     ]
 
 
+def make_spec(mode: str) -> dict:
+    return {
+        "format": "llmss-scenario/1",
+        "name": f"bench-router-{mode}",
+        "seed": 0,
+        "broker": {"kind": "inproc", "lease_s": 10.0},
+        "cost_model": {
+            "kind": "table",
+            # Full prompt (prefix + 1 suffix token) on a miss prices at
+            # MISS_COST_S; a COW hit prefills only the suffix token.
+            "prefill_token_s": MISS_COST_S / (PREFIX_LEN + 1),
+            "decode_step_s": TOKEN_COST_S,
+        },
+        "fleet": {
+            "replicas": [{
+                "count": N_WORKERS, "role": "unified", "rows": 1,
+                "chunk_tokens": MAX_NEW, "prefill_chunk": PREFIX_LEN + 1,
+                "admit_burst": 1, "prefix_lru_slots": LRU_SLOTS,
+            }],
+            "router_policy": (
+                "prefix_affinity" if mode == "affinity" else "shared"
+            ),
+        },
+        "workload": {"kind": "trace", "rows": make_trace_rows()},
+    }
+
+
 def run_mode(mode: str) -> dict:
-    broker = InProcBroker()
-    submit_ts: dict[str, float] = {}
-    ttfts: list[float] = []
-    hits: list[str] = []
-    misses: list[str] = []
-    lock = threading.Lock()
-    workers = [
-        SimWorker(f"w{i}", broker, submit_ts, ttfts, hits, misses, lock)
-        for i in range(N_WORKERS)
-    ]
-    router = Router(broker, "prefix_affinity") if mode == "affinity" else None
-    reqs = make_trace()
-    for w in workers:
-        w.start()
-    deadline = time.monotonic() + 10.0
-    while len(broker.read_workers()) < N_WORKERS:
-        if time.monotonic() > deadline:
-            raise RuntimeError("workers never registered")
-        time.sleep(0.01)
-    t0 = time.monotonic()
-    for r in reqs:
-        submit_ts[r.id] = time.monotonic()
-        if router is not None:
-            router.submit(r)
-        else:
-            broker.push_request(r)
-    for r in reqs:
-        resp = broker.wait_response(r.id, timeout=60.0)
-        assert resp is not None and not resp.error, r.id
-    elapsed = time.monotonic() - t0
-    for w in workers:
-        w.stop()
-    n = len(hits) + len(misses)
+    sim = FleetSim(make_spec(mode))
+    report = sim.run()
+    tp = report["throughput"]
+    elapsed = (
+        tp["tokens_out"] / tp["tokens_per_s"] if tp["tokens_per_s"] else 0.0
+    )
+    hits = sim.counters["prefix_hits"]
+    n = hits + sim.counters["prefix_misses"]
     out = {
         "mode": mode,
         "requests": n,
-        "prefix_hit_rate": round(len(hits) / n, 4),
-        "ttft_p50_ms": round(statistics.median(ttfts) * 1e3, 3),
-        "ttft_p95_ms": round(
-            statistics.quantiles(ttfts, n=20)[18] * 1e3, 3
-        ),
-        "tokens_per_s": round(sum(w.tokens_done for w in workers) / elapsed, 1),
+        "prefix_hit_rate": round(hits / n, 4),
+        "ttft_p50_ms": round(report["latency_ms"]["ttft_p50"], 3),
+        "ttft_p95_ms": round(report["latency_ms"]["ttft_p95"], 3),
+        "tokens_per_s": round(tp["tokens_out"] / elapsed, 1)
+        if elapsed else 0.0,
         "elapsed_s": round(elapsed, 3),
     }
-    if router is not None:
-        out["router"] = router.stats()
+    if sim.router is not None:
+        out["router"] = sim.router.stats()
     return out
 
 

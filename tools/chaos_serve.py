@@ -22,6 +22,8 @@ Examples::
     python tools/chaos_serve.py --fault drain   # lifecycle scenarios:
     python tools/chaos_serve.py --fault hang    #   supervised worker +
     python tools/chaos_serve.py --fault nan     #   scripted failure
+    python tools/chaos_serve.py --scenario scenarios/storm.json \
+        --requests 60                           # sim-scenario parity
 
 Prints a one-line JSON delivery report.
 """
@@ -31,6 +33,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import sys
 import threading
 import time
@@ -49,6 +52,10 @@ from llmss_tpu.serve.protocol import (  # noqa: E402
     GenerateRequest,
 )
 from llmss_tpu.serve.supervisor import Supervisor  # noqa: E402
+from llmss_tpu.sim.invariants import (  # noqa: E402
+    audit_exactly_once,
+    collect_responses,
+)
 
 
 def build_brokers(args):
@@ -504,6 +511,232 @@ def run_burst(args):
     return 1 if violations else 0
 
 
+def run_scenario(args):
+    """Replay a fleet-simulator scenario's fault plane against a REAL
+    in-process fleet (``--scenario file.json``).
+
+    The simulator (``llmss_tpu/sim/``) runs these scenario files on a
+    virtual clock; this mode is the parity check — same fault kinds,
+    actual threads and wall time, audited with the same shared helpers
+    (``collect_responses`` / ``audit_exactly_once``). The scenario's
+    virtual schedule maps onto wall time via ``--time-scale`` and is
+    truncated at ``--scenario-wall-s``; the fleet's role mix is kept but
+    scaled down to ``--workers`` machines; the request count comes from
+    ``--requests`` (the scenario's own count is a sim-scale number).
+
+    Fault mapping (virtual -> wall):
+
+    - ``kill_wave`` / ``handoff_storm``: a one-shot ``HardKill`` window
+      (``kill_after_pop_prob=1.0`` until the scaled respawn delay
+      elapses) on request-popping workers; ``handoff_storm`` prefers
+      prefill replicas so the export-then-die window is exercised.
+    - ``partition``: ``ChaosBroker.partition_for`` — ops raise builtin
+      ``ConnectionError``; hosts reconnect, held leases rot to
+      redelivery.
+    - ``latency_spike``: ``op_latency_s = extra_s`` for the scaled
+      window.
+    - ``heartbeat_stall``: an op-latency window longer than the lease,
+      so the reaper races the stalled worker's late answers.
+    """
+    with open(args.scenario) as f:
+        spec = json.load(f)
+    scale = args.time_scale
+    rng = random.Random(int(spec.get("seed", 0)) ^ args.seed)
+    br_spec = spec.get("broker") or {}
+    args.max_attempts = int(
+        br_spec.get("max_delivery_attempts", args.max_attempts)
+    )
+
+    # -- fleet: keep the scenario's role mix, scaled to --workers ------------
+    groups = (spec.get("fleet") or {}).get("replicas") or [
+        {"count": args.workers, "role": "unified"}
+    ]
+    total = sum(int(g.get("count", 1)) for g in groups)
+    roles: list[str] = []
+    for g in groups:
+        n = max(1, round(int(g.get("count", 1)) * args.workers / total))
+        roles.extend([g.get("role", "unified")] * n)
+    args.workers = len(roles)
+    prod_broker, worker_brokers = build_brokers(args)
+
+    proxies: list[ChaosBroker] = []
+    hosts: list[ChaosWorkerHost] = []
+    popper_idx: list[int] = []   # workers that pop_request (killable pool)
+    prefill_idx: list[int] = []
+    for i, (role, wb) in enumerate(zip(roles, worker_brokers)):
+        chaos = ChaosBroker(wb, seed=int(spec.get("seed", 0)) + i)
+        proxies.append(chaos)
+        delay = args.chunk_delay_s
+        if role == "prefill":
+            popper_idx.append(i)
+            prefill_idx.append(i)
+
+            def factory(c=chaos, i=i, delay=delay):
+                return PrefillWorker(
+                    ScriptedEngine(chunk_delay_s=delay), c,
+                    worker_id=f"prefill{i}", poll_timeout_s=0.02,
+                )
+        elif role == "decode":
+
+            def factory(c=chaos, i=i, delay=delay):
+                return DecodeWorker(
+                    ScriptedEngine(chunk_delay_s=delay), c,
+                    worker_id=f"decode{i}", poll_timeout_s=0.02,
+                )
+        else:
+            popper_idx.append(i)
+
+            def factory(c=chaos, delay=delay):
+                return Worker(
+                    ScriptedEngine(kill_on_poison=True, chunk_delay_s=delay),
+                    c, batch_size=args.batch_size, poll_timeout_s=0.02,
+                    pad_batch=False,
+                )
+
+        hosts.append(ChaosWorkerHost(factory, respawn_delay_s=0.05))
+
+    # -- fault schedule: expand repeats, scale, truncate ---------------------
+    duration = float(spec.get("duration_s", 60.0))
+    instances: list[tuple[float, dict]] = []
+    for f in spec.get("faults", ()):
+        t = float(f.get("at_s", 0.0))
+        rep = float(f.get("repeat_every_s", 0.0) or 0.0)
+        while t < duration:
+            wall = t * scale
+            if wall < args.scenario_wall_s:
+                instances.append((wall, f))
+            if rep <= 0:
+                break
+            t += rep
+    instances.sort(key=lambda p: p[0])
+
+    timers: list[threading.Timer] = []
+
+    def at(wall_t, fn, *fn_args):
+        tm = threading.Timer(wall_t, fn, fn_args)
+        tm.daemon = True
+        timers.append(tm)
+
+    def pick(n, pool):
+        if not pool:
+            return []
+        if n == "*" or int(n) >= len(pool):
+            return list(pool)
+        start = rng.randrange(len(pool))
+        return [pool[(start + j) % len(pool)] for j in range(int(n))]
+
+    def kill_window(idxs, hold_s):
+        for i in idxs:
+            proxies[i].kill_after_pop_prob = 1.0
+
+        def relax():
+            for i in idxs:
+                proxies[i].kill_after_pop_prob = 0.0
+        tm = threading.Timer(max(0.1, hold_s), relax)
+        tm.daemon = True
+        tm.start()
+
+    for wall_t, f in instances:
+        kind = f.get("kind")
+        if kind in ("kill_wave", "handoff_storm"):
+            pool = prefill_idx if (
+                kind == "handoff_storm" and prefill_idx
+            ) else popper_idx
+            idxs = pick(f.get("count", 1), pool)
+            hold = float(f.get("respawn_after_s", 1.0)) * scale
+            stagger = float(f.get("stagger_s", 0.0)) * scale
+            for k, i in enumerate(idxs):
+                at(wall_t + k * stagger, kill_window, [i], hold)
+        elif kind == "partition":
+            dur = float(f.get("duration_s", 1.0)) * scale
+            for i in pick(f.get("targets", 1), popper_idx):
+                at(wall_t, proxies[i].partition_for, dur)
+        elif kind in ("latency_spike", "heartbeat_stall"):
+            if kind == "latency_spike":
+                extra = float(f.get("extra_s", 0.05))
+                idxs = pick(f.get("targets", "*"), popper_idx)
+            else:  # stall past the lease so redelivery must race the worker
+                extra = args.lease_s * 1.2
+                idxs = pick(f.get("count", 1), popper_idx)
+            dur = float(f.get("duration_s", 1.0)) * scale
+
+            def spike(idxs=idxs, extra=extra, dur=dur):
+                for i in idxs:
+                    proxies[i].op_latency_s = extra
+
+                def calm():
+                    for i in idxs:
+                        proxies[i].op_latency_s = 0.0
+                tm = threading.Timer(dur, calm)
+                tm.daemon = True
+                tm.start()
+            at(wall_t, spike)
+
+    # -- paced traffic so faults land on live work ---------------------------
+    n_poison = args.poison
+    reqs = []
+    for i in range(args.requests):
+        prompt = [POISON_TOKEN] if i < n_poison else [i % 1000 + 1, i % 7 + 1]
+        reqs.append(GenerateRequest(
+            token_ids=prompt, max_new_tokens=4,
+            slo_class=SLO_CLASSES[i % len(SLO_CLASSES)],
+            deadline_ts=time.time() + args.deadline_s,
+        ))
+    span = max((instances[-1][0] + 0.5) if instances else 0.0, 1.0)
+
+    def feed():
+        gap = span / max(1, len(reqs))
+        for r in reqs:
+            prod_broker.push_request(r)
+            time.sleep(gap)
+
+    feeder = threading.Thread(target=feed, daemon=True)
+    for h in hosts:
+        h.start()
+    for tm in timers:
+        tm.start()
+    feeder.start()
+
+    results = collect_responses(prod_broker, reqs, timeout_s=args.deadline_s)
+
+    for tm in timers:
+        tm.cancel()
+    for h in hosts:
+        h.stop()
+
+    violation = None
+    successes = 0
+    try:
+        successes = audit_exactly_once(
+            reqs, results, broker=prod_broker,
+            poison_ids=[reqs[i].id for i in range(n_poison)],
+        )
+    except AssertionError as e:
+        violation = str(e)
+
+    def fsum(key):
+        return sum(p.faults[key] for p in proxies)
+
+    report = {
+        "scenario": spec.get("name"),
+        "requests": args.requests,
+        "workers": {r: roles.count(r) for r in dict.fromkeys(roles)},
+        "ok": successes,
+        "fault_instances": len(instances),
+        "kills": sum(h.kills for h in hosts),
+        "spawns": sum(h.spawns for h in hosts),
+        "reconnects": sum(h.reconnects for h in hosts),
+        "partition_errors": fsum("partition_errors"),
+        "latency_injections": fsum("latency_injections"),
+        "dlq_depth": prod_broker.dlq_depth(),
+        "delivery": prod_broker.delivery_stats(),
+        "host_errors": [h.error for h in hosts if h.error],
+        "violation": violation,
+    }
+    print(json.dumps(report))
+    return 1 if (violation or report["host_errors"]) else 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         "chaos_serve", description=__doc__.split("\n")[0]
@@ -534,8 +767,20 @@ def main(argv=None):
     p.add_argument("--kills", type=int, default=3,
                    help="kill-mid-handoff: how many exports get the "
                         "prefill replica killed before push_handoff")
+    p.add_argument("--scenario", default=None,
+                   help="replay a sim scenario file's fault plane against "
+                        "a real in-proc fleet (parity with llmss_tpu/sim)")
+    p.add_argument("--time-scale", type=float, default=0.05,
+                   help="scenario: virtual seconds -> wall seconds factor")
+    p.add_argument("--scenario-wall-s", type=float, default=4.0,
+                   help="scenario: truncate the scaled fault schedule here")
+    p.add_argument("--chunk-delay-s", type=float, default=0.005,
+                   help="scenario: per-chunk engine delay so traffic "
+                        "overlaps the fault window")
     args = p.parse_args(argv)
 
+    if args.scenario is not None:
+        return run_scenario(args)
     if args.fault == "kill-mid-handoff":
         return run_kill_mid_handoff(args)
     if args.fault == "burst":

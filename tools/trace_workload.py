@@ -103,6 +103,11 @@ def synthesize_request(
     cls = row.get("slo_class") or row.get("priority")
     if cls in SLO_CLASSES:
         req.slo_class = cls
+    # session_id is optional in the capture (older workload files predate
+    # it); present, it restores per-session arrival structure.
+    sess = row.get("session_id")
+    if sess:
+        req.session_id = str(sess)
     ph = row.get("prefix_hash")
     if ph is not None:
         if prefixes is None:
