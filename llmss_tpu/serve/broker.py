@@ -237,6 +237,31 @@ class Broker(abc.ABC):
         Returns the requests the caller should re-route to survivors."""
         return []
 
+    # -- controller epoch fencing --------------------------------------------
+    # The fleet controller (serve/controller.py) fences every actuation
+    # through the broker: taking leadership bumps a fleet-wide monotonic
+    # epoch, and a controller whose epoch is no longer current must treat
+    # every planned spawn/retire as a no-op. The base implementation keeps
+    # the epoch in-process — correct whenever all controllers share one
+    # broker object (sim, tests, single-host serving); RedisBroker
+    # overrides with INCR so the fence survives process boundaries.
+
+    def acquire_controller_epoch(self, controller_id: str = "") -> int:
+        """Take controller leadership: bump and return the fleet epoch.
+        Any controller holding an older epoch is fenced from actuating."""
+        epoch = getattr(self, "_ctrl_epoch", 0) + 1
+        self._ctrl_epoch = epoch
+        self._ctrl_holder = controller_id
+        return epoch
+
+    def controller_epoch(self) -> int:
+        """Current fleet controller epoch (0 = no controller ever)."""
+        return getattr(self, "_ctrl_epoch", 0)
+
+    def controller_holder(self) -> str:
+        """controller_id of the latest epoch holder ('' if none)."""
+        return getattr(self, "_ctrl_holder", "")
+
     def _expiry_disposition(self, req: GenerateRequest) -> str:
         """Policy for a lease that timed out un-acked:
         ``'expired'`` (end-to-end deadline passed — shed),
@@ -507,6 +532,12 @@ class InProcBroker(Broker):
                 del self._worker_expiry[wid]
                 self._workers.pop(wid, None)
             return {wid: dict(info) for wid, info in self._workers.items()}
+
+    def acquire_controller_epoch(self, controller_id: str = "") -> int:
+        with self._worker_lock:
+            self._ctrl_epoch = getattr(self, "_ctrl_epoch", 0) + 1
+            self._ctrl_holder = controller_id
+            return self._ctrl_epoch
 
     def push_request_to(self, worker_id: str, req: GenerateRequest) -> None:
         trace.ensure_context(req)
@@ -1237,6 +1268,26 @@ class RedisBroker(Broker):
             entry.pop("_expires_at", None)
             out[entry["worker_id"]] = entry
         return out
+
+    # -- controller epoch fencing --------------------------------------------
+    # The epoch lives at {pqueue}:ctrl:epoch (INCR is atomic server-side),
+    # so a controller restarted in a different process fences out any
+    # zombie predecessor that still thinks it leads the fleet.
+
+    def acquire_controller_epoch(self, controller_id: str = "") -> int:
+        epoch = int(self._r.incr(f"{self._rq}:ctrl:epoch"))
+        self._r.set(f"{self._rq}:ctrl:holder", controller_id)
+        return epoch
+
+    def controller_epoch(self) -> int:
+        raw = self._r.get(f"{self._rq}:ctrl:epoch")
+        return int(raw) if raw else 0
+
+    def controller_holder(self) -> str:
+        raw = self._r.get(f"{self._rq}:ctrl:holder")
+        if raw is None:
+            return ""
+        return raw.decode() if isinstance(raw, bytes) else str(raw)
 
     def push_request_to(self, worker_id: str, req: GenerateRequest) -> None:
         trace.ensure_context(req)

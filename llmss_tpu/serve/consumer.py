@@ -1107,9 +1107,20 @@ def main(argv=None):
         )
 
         def _on_sigterm(signum, frame):
-            logger.info("SIGTERM: draining (deadline %.0fs)",
-                        args.drain_timeout_s)
-            sup.drain()
+            # First SIGTERM: graceful drain, refused if this is the last
+            # routable replica of its role (drain_blocked advisory).
+            # Second SIGTERM: the operator means it — force teardown.
+            if sup.drain(force=sup.draining or _sig_seen["n"] > 0):
+                logger.info("SIGTERM: draining (deadline %.0fs)",
+                            args.drain_timeout_s)
+            else:
+                logger.warning(
+                    "SIGTERM: drain blocked (last routable replica); "
+                    "send SIGTERM again to force teardown"
+                )
+            _sig_seen["n"] += 1
+
+        _sig_seen = {"n": 0}
 
         signal.signal(signal.SIGTERM, _on_sigterm)
         sup.run()

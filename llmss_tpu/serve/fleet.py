@@ -186,7 +186,8 @@ class BrownoutController:
         dwell_s: float = 5.0,
         check_s: float = 1.0,
         batch_max_new_cap: int = 64,
-        retry_after_s: int = 2,
+        retry_after_s: int | None = None,
+        escalate_ok=None,
     ):
         if high <= low:
             raise ValueError(f"need high > low, got {high} <= {low}")
@@ -196,7 +197,20 @@ class BrownoutController:
         self.dwell_s = dwell_s
         self.check_s = check_s
         self.batch_max_new_cap = batch_max_new_cap
-        self.retry_after_s = retry_after_s
+        # Shed Retry-After defaults to the de-escalation dwell: the
+        # ladder cannot drop a rung sooner than ``dwell_s`` after burn
+        # cools, so telling the client to come back earlier just buys it
+        # another 429.
+        self.retry_after_s = (
+            max(1, int(round(dwell_s))) if retry_after_s is None
+            else retry_after_s
+        )
+        # Scale-before-shed escalation contract: when set (the fleet
+        # controller's ``escalation_allowed``), the ladder may CLIMB only
+        # if the callable returns True — i.e. scaling demonstrably cannot
+        # respond in time. De-escalation is never gated.
+        self.escalate_ok = escalate_ok
+        self._suppressed_escalations = 0  # guarded_by: self._lock
         self._lock = threading.Lock()
         self._rung = 0  # guarded_by: self._lock
         self._last_burn = 0.0  # guarded_by: self._lock
@@ -223,7 +237,12 @@ class BrownoutController:
                 self._last_hot = now
             rung = self._rung
             if burn > self.high and rung < len(self.LADDER) - 1:
-                rung += 1
+                if self.escalate_ok is None or self.escalate_ok():
+                    rung += 1
+                else:
+                    # Scaling can still respond in time — hold the rung
+                    # and let capacity, not shedding, absorb the burn.
+                    self._suppressed_escalations += 1
             elif (
                 burn < self.low and rung > 0
                 and now - self._last_hot >= self.dwell_s
@@ -273,6 +292,7 @@ class BrownoutController:
                 "low": self.low,
                 "dwell_s": self.dwell_s,
                 "transitions_total": self._transitions,
+                "suppressed_escalations": self._suppressed_escalations,
                 "recent_transitions": list(self._history),
             }
 

@@ -11,8 +11,11 @@ own request id.
 
 from __future__ import annotations
 
+import collections
 import json
+import math
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
@@ -53,15 +56,69 @@ _PROFILE_GRACE_S = 5.0
 CLASS_DEPTH_FRACTION = {SLO_CLASS_BATCH: 0.5}
 
 
+class QueueDrainEstimator:
+    """Windowed queue service-rate tracker behind honest Retry-After.
+
+    Both frontends used to stamp a hardcoded ``Retry-After: 1`` on
+    queue-depth 429s — a lie whenever the backlog needs more than a
+    second to drain, and a thundering-herd invitation since every shed
+    client retries in lockstep. This keeps a short window of
+    ``(t, admitted_total, depth)`` samples (one per admitted request);
+    the service rate over the window is what left the queue —
+    ``(admitted Δ − depth Δ) / Δt`` — and the suggested retry is the
+    current depth divided by that rate, clamped to [min_s, max_s].
+    Fewer than two samples, or a rate estimate ≤ 0 (queue growing or
+    stalled), degrade conservatively: the legacy 1s, or the max clamp.
+    """
+
+    def __init__(self, *, window_s: float = 10.0, min_s: int = 1,
+                 max_s: int = 30):
+        self.window_s = window_s
+        self.min_s = min_s
+        self.max_s = max_s
+        self._lock = threading.Lock()
+        self._admitted = 0  # guarded_by: self._lock
+        self._samples: collections.deque = collections.deque()  # guarded_by: self._lock
+
+    def note_admitted(self, depth: int, now: float | None = None) -> None:
+        """Record one admission with the queue depth observed AFTER it."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._admitted += 1
+            self._samples.append((now, self._admitted, depth))
+            cutoff = now - self.window_s
+            while len(self._samples) > 2 and self._samples[0][0] < cutoff:
+                self._samples.popleft()
+
+    def retry_after_s(self, depth: int, now: float | None = None) -> int:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if len(self._samples) < 2:
+                return self.min_s  # no signal: legacy behavior
+            t0, adm0, d0 = self._samples[0]
+            t1, adm1, d1 = self._samples[-1]
+        dt = t1 - t0
+        if dt <= 0:
+            return self.min_s
+        served = (adm1 - adm0) - (d1 - d0)
+        rate = served / dt
+        if rate <= 0:
+            return self.max_s  # draining nothing: back way off
+        return max(self.min_s, min(self.max_s, math.ceil(depth / rate)))
+
+
 def admission_verdict(
     req: GenerateRequest, broker: Broker, max_queue_depth: int,
-    brownout=None,
+    brownout=None, drain: QueueDrainEstimator | None = None,
 ) -> tuple[int, dict, dict] | None:
     """Class-aware shed decision shared by both producer frontends:
     ``None`` admits (a brownout rung may have capped a batch request's
     ``max_new_tokens`` in place); otherwise ``(status, body, headers)``
     for the 429. Checked in ladder-first order so a browned-out class
-    reads the brownout reason, not a coincidental queue-depth one."""
+    reads the brownout reason, not a coincidental queue-depth one.
+    Brownout sheds carry the ladder's dwell-derived Retry-After;
+    queue-depth sheds derive theirs from the windowed drain rate when a
+    ``QueueDrainEstimator`` is wired in."""
     if brownout is not None:
         ok, retry_after = brownout.admit(req)
         if not ok:
@@ -75,10 +132,11 @@ def admission_verdict(
         limit = max(1, int(max_queue_depth * frac))
         depth = broker.queue_depth()
         if depth >= limit:
+            retry = drain.retry_after_s(depth) if drain is not None else 1
             return 429, {
                 "error": "queue full", "id": req.id, "queue_depth": depth,
                 "slo_class": req.slo_class,
-            }, {"Retry-After": "1"}
+            }, {"Retry-After": str(retry)}
     return None
 
 
@@ -319,8 +377,15 @@ class ProducerServer:
     def __init__(self, broker: Broker, host: str = "0.0.0.0",
                  port: int = 8000, timeout_s: float = 300.0,
                  max_queue_depth: int = 1024, router=None,
-                 slo_objectives=None, brownout=None):
+                 slo_objectives=None, brownout=None, controller=None):
         self.broker = broker
+        # Optional serve.controller.FleetController: surfaced on /fleet
+        # so operators see the reconciler's epoch / counters / last
+        # action next to the registry it acts on. The producer never
+        # ticks it — whoever owns the control loop does.
+        self.controller = controller
+        # Windowed queue drain rate behind queue-depth 429 Retry-After.
+        self.drain_estimator = QueueDrainEstimator()
         # Burn-rate-driven brownout ladder: None builds the default
         # controller fed by this server's own /slo view of interactive
         # TTFT burn. With no traffic the burn reads 0.0, so the default
@@ -463,7 +528,7 @@ class ProducerServer:
                 outer.brownout.tick()
                 verdict = admission_verdict(
                     req, outer.broker, outer.max_queue_depth,
-                    outer.brownout,
+                    outer.brownout, drain=outer.drain_estimator,
                 )
                 if verdict is not None:
                     code, payload, headers = verdict
@@ -623,6 +688,7 @@ class ProducerServer:
             self.router.submit(req)
         else:
             self.broker.push_request(req)
+        self.drain_estimator.note_admitted(self.broker.queue_depth())
 
     def health(self) -> tuple[int, dict]:
         """Worker-health-aware /health. With a populated worker registry
@@ -654,6 +720,8 @@ class ProducerServer:
             self.broker, self.router, self.HEARTBEAT_STALE_FACTOR,
         )
         out["brownout"] = self.brownout.state()
+        if self.controller is not None:
+            out["controller"] = self.controller.state()
         return out
 
     def metrics_payload(self) -> dict:
@@ -800,7 +868,8 @@ class ProducerServer:
 
 def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
                        max_queue_depth: int = 1024, router=None,
-                       slo_objectives=None, brownout=None):
+                       slo_objectives=None, brownout=None,
+                       controller=None):
     """FastAPI variant of the producer (optional dependency, gated).
 
     Full API parity with ``ProducerServer``: POST /generate (JSON or SSE
@@ -836,11 +905,14 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
 
         brownout = BrownoutController(_burn)
 
+    drain_estimator = QueueDrainEstimator()
+
     def _submit(req: GenerateRequest) -> None:
         if router is not None:
             router.submit(req)
         else:
             broker.push_request(req)
+        drain_estimator.note_admitted(broker.queue_depth())
 
     def _worker_unavailable() -> str | None:
         now = _time.monotonic()
@@ -919,6 +991,7 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
         brownout.tick()
         verdict = admission_verdict(
             req, broker, max_queue_depth, brownout,
+            drain=drain_estimator,
         )
         if verdict is not None:
             code, content, headers = verdict
@@ -1051,6 +1124,8 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
             broker, router, ProducerServer.HEARTBEAT_STALE_FACTOR,
         )
         out["brownout"] = brownout.state()
+        if controller is not None:
+            out["controller"] = controller.state()
         return out
 
     @app.get("/dlq")

@@ -212,17 +212,70 @@ class Supervisor:
 
     # -- drain ---------------------------------------------------------------
 
-    def drain(self, timeout_s: float | None = None) -> None:
+    def drain(self, timeout_s: float | None = None, *,
+              force: bool = False) -> bool:
         """Begin a graceful shutdown (thread-safe; SIGTERM handler calls
         this). The loop stops leasing new requests, finishes active rows,
         acks them, and exits with state ``dead``. Past the deadline
         (``timeout_s``, default ``drain_timeout_s``) never-started requests
         are released back to the queue for other workers and still-active
-        rows are aborted with an error — a stuck row can't pin the drain."""
+        rows are aborted with an error — a stuck row can't pin the drain.
+
+        Last-routable guard: when the registry shows NO other routable
+        replica of this worker's role, draining would take the fleet to
+        zero — the request is refused (returns False), logged, and a
+        ``drain_blocked`` advisory is published on the worker's registry
+        entry so operators can see the refused teardown on /fleet. Pass
+        ``force=True`` for deliberate full teardown (e.g. a second
+        SIGTERM). Returns True when the drain actually began."""
+        if not force and self._drain_blocked_reason() is not None:
+            return False
         self._drain_deadline = time.monotonic() + (
             timeout_s if timeout_s is not None else self.drain_timeout_s
         )
         self._drain.set()
+        return True
+
+    def _drain_blocked_reason(self) -> str | None:
+        """None when draining is safe; else why it must not proceed.
+
+        Registry-free deployments (no worker_id / nothing registered)
+        have nothing to guard with — drain proceeds as before. The
+        advisory is published as a FIELD on the worker's entry, never as
+        a lifecycle state: flipping state off ``ready`` would itself
+        unroute the worker — exactly the outage the guard exists to
+        prevent."""
+        from llmss_tpu.serve.fleet import routable_workers
+
+        worker = self._worker
+        wid = getattr(worker, "worker_id", None)
+        if wid is None:
+            return None
+        try:
+            routable = routable_workers(self.broker)
+        except Exception:  # noqa: BLE001 — registry down: do not block drain
+            return None
+        if not routable or wid not in routable:
+            # Nothing registered (registry-free deployment) or we are
+            # already unroutable — the guard protects nothing.
+            return None
+        role = routable[wid].get("role", "unified")
+        others = [
+            w for w, info in routable.items()
+            if w != wid and info.get("role", "unified") == role
+        ]
+        if others:
+            return None
+        reason = (
+            f"last routable {role} replica: drain would take the fleet "
+            f"to zero (use force for deliberate teardown)"
+        )
+        logger.warning("drain blocked: %s", reason)
+        try:
+            self.broker.publish_worker_load(wid, {"drain_blocked": reason})
+        except Exception:  # noqa: BLE001 — advisory only
+            logger.warning("drain_blocked publish failed", exc_info=True)
+        return reason
 
     @property
     def draining(self) -> bool:

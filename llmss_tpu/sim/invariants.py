@@ -70,6 +70,10 @@ class InvariantChecker:
         self._violations: list[str] = []
         self._pending = 0
         self._brokers: list = []
+        # Controller-lifecycle accounts (catalog items 7-9).
+        self._known_workers: set[str] = set()
+        self._ctrl_spawned: set[str] = set()
+        self._ctrl_draining: set[str] = set()
 
     # -- wiring ---------------------------------------------------------------
 
@@ -169,6 +173,51 @@ class InvariantChecker:
             )
             if resp.token_ids != expect:
                 self._violations.append(f"corrupt payload for {resp.id}")
+
+    # -- controller lifecycle -------------------------------------------------
+    #
+    # 7.  no duplicate worker_ids: a controller spawn must mint a fresh
+    #     worker_id, never reuse one from any earlier epoch (a reused id
+    #     would alias registry rows and lease scopes);
+    # 8.  drains precede retirement: a replica only reaches its terminal
+    #     (dead) publish through an announced drain — an undrained
+    #     retirement is a kill wearing a retirement hat;
+    # 9.  floor never violated: no controller retire may take a role's
+    #     ready count below its configured floor.
+
+    def note_worker(self, worker_id: str) -> None:
+        """Seed the known-id set with a pre-existing (non-controller)
+        fleet member."""
+        if worker_id in self._known_workers:
+            self._violations.append(
+                f"duplicate worker_id in initial fleet: {worker_id}"
+            )
+        self._known_workers.add(worker_id)
+
+    def on_controller_spawn(self, worker_id: str) -> None:
+        if worker_id in self._known_workers:
+            self._violations.append(
+                f"controller spawned duplicate worker_id {worker_id}"
+            )
+        self._known_workers.add(worker_id)
+        self._ctrl_spawned.add(worker_id)
+
+    def on_controller_drain(self, worker_id: str) -> None:
+        self._ctrl_draining.add(worker_id)
+
+    def on_controller_retired(self, worker_id: str) -> None:
+        if worker_id not in self._ctrl_draining:
+            self._violations.append(
+                f"{worker_id} retired without a preceding drain"
+            )
+        self._ctrl_draining.discard(worker_id)
+
+    def on_fleet_retire(self, role: str, remaining: int, floor: int) -> None:
+        if remaining < floor:
+            self._violations.append(
+                f"retire took role {role} below floor "
+                f"({remaining} < {floor})"
+            )
 
     # -- KV block accounts ----------------------------------------------------
 

@@ -36,7 +36,10 @@ from llmss_tpu.serve.fleet import routable_workers
 from llmss_tpu.serve.handoff import HandoffRecord, pick_decode_worker
 from llmss_tpu.serve.protocol import (
     SLO_CLASS_RANK,
+    STATE_DEAD,
+    STATE_DRAINING,
     STATE_READY,
+    STATE_STARTING,
     GenerateResponse,
     prefix_hash,
 )
@@ -113,6 +116,15 @@ class SimReplica:
         self.cost = cost or sim.cost
         self.alive = False
         self.gen = 0
+        # Controller lifecycle: a spawned replica is registry-visible as
+        # ``starting`` through its cold-start, a retired one drains (no
+        # new leases, pending released refunded) before publishing dead.
+        self.spawning = False
+        self.draining = False
+        # Provisioned chip-seconds (cold-start included — a provisioning
+        # chip is a paid-for chip): the autoscale bench's cost metric.
+        self._alive_since: float | None = None
+        self.alive_s = 0.0
         self.stalled_until = 0.0
         self.active: list[_Row] = []
         self.pending: collections.deque = collections.deque()
@@ -128,9 +140,28 @@ class SimReplica:
 
     # -- lifecycle ------------------------------------------------------------
 
+    def _mark_up(self) -> None:
+        if self._alive_since is None:
+            self._alive_since = self.sim.clock.now
+            self.sim.on_replica_up()
+
+    def _mark_down(self) -> None:
+        if self._alive_since is not None:
+            self.alive_s += self.sim.clock.now - self._alive_since
+            self._alive_since = None
+            self.sim.on_replica_down()
+
+    def alive_seconds(self, now: float) -> float:
+        """Total provisioned chip-seconds, the current stretch included."""
+        extra = now - self._alive_since if self._alive_since is not None else 0.0
+        return self.alive_s + extra
+
     def start(self) -> None:
         self.alive = True
+        self.spawning = False
+        self.draining = False
         self.gen += 1
+        self._mark_up()
         self.last_touch = self.sim.clock.now
         self.broker.register_worker({
             "worker_id": self.wid, "model": "sim", "role": self.role,
@@ -140,6 +171,80 @@ class SimReplica:
         self._idle = True
         self.nudge()
 
+    def spawn(self, cold_start_s: float) -> None:
+        """Controller spawn with modeled cold start: the replica is
+        registry-visible as ``starting`` immediately — so a reconciling
+        controller counts it as capacity and never double-spawns — but
+        takes no work until the cold-start elapses and ``start`` flips
+        it to ``ready``."""
+        self.gen += 1
+        gen = self.gen
+        self.spawning = True
+        self._mark_up()
+        self.broker.register_worker({
+            "worker_id": self.wid, "model": "sim", "role": self.role,
+            "state": STATE_STARTING,
+            "heartbeat_ts": self.sim.clock.time(),
+            "heartbeat_s": self.heartbeat_s,
+        })
+
+        def beat():
+            if gen != self.gen or not self.spawning:
+                return
+            self.broker.publish_worker_load(self.wid, {
+                "state": STATE_STARTING, "alive": True, "role": self.role,
+                "heartbeat_ts": self.sim.clock.time(),
+                "heartbeat_s": self.heartbeat_s,
+            })
+            self.sim.loop.call_after(self.heartbeat_s, beat)
+
+        self.sim.loop.call_after(self.heartbeat_s, beat)
+        self.sim.loop.call_after(cold_start_s, lambda: (
+            self._finish_spawn(gen)
+        ))
+
+    def _finish_spawn(self, gen: int) -> None:
+        if gen != self.gen or not self.spawning:
+            return
+        self.start()
+
+    def retire(self) -> None:
+        """Controller-initiated drain (the PR 2 lifecycle, sim-side):
+        stop leasing new work, release never-started pending rows back
+        to their class queues REFUNDED (deliberate retirement must not
+        consume delivery attempts), finish in-flight rows, then publish
+        ``dead``. A still-cold-starting replica cancels its spawn."""
+        if self.spawning and not self.alive:
+            self.spawning = False
+            self.gen += 1
+            self._mark_down()
+            self.broker.deregister_worker(self.wid)
+            self.sim.checker.on_controller_retired(self.wid)
+            return
+        if self.draining or not self.alive:
+            return
+        self.draining = True
+        if self.pending:
+            self.broker.release_requests([r.req.id for r in self.pending])
+            self.sim.counters["retire_released"] += len(self.pending)
+            self.pending.clear()
+        self._publish()  # announce ``draining``: routers stop routing here
+        self.nudge()
+
+    def _finish_retire(self) -> None:
+        self.alive = False
+        self.draining = False
+        self.gen += 1
+        self._mark_down()
+        # Terminal publish, same contract as the supervisor's lifecycle
+        # exit: routers fail over promptly instead of waiting out TTL.
+        self.broker.publish_worker_load(self.wid, {
+            "state": STATE_DEAD, "alive": False, "role": self.role,
+            "heartbeat_ts": self.sim.clock.time(),
+        })
+        self.sim.counters["retired"] += 1
+        self.sim.checker.on_controller_retired(self.wid)
+
     def kill(self, respawn_after_s: float | None = None) -> None:
         """Hard kill: in-flight rows, unsettled completions, pending
         pops, and KV vanish with the process; leases are left to rot —
@@ -148,7 +253,9 @@ class SimReplica:
         if not self.alive:
             return
         self.alive = False
+        self.draining = False
         self.gen += 1
+        self._mark_down()
         self._drop_all_rows()
         self.sim.counters["kills"] += 1
         if respawn_after_s is not None:
@@ -196,7 +303,7 @@ class SimReplica:
     def _snapshot(self) -> dict:
         free_rows = self.rows - len(self.active)
         return {
-            "state": STATE_READY,
+            "state": STATE_DRAINING if self.draining else STATE_READY,
             "alive": True,
             "role": self.role,
             "rows": self.rows,
@@ -300,6 +407,10 @@ class SimReplica:
             sim.loop.call_after(
                 max(busy, 1e-4), lambda: self._step(gen)
             )
+        elif self.draining:
+            # Everything settled: the drain is complete (the real
+            # supervisor's clean-exit path — drains precede retirement).
+            self._finish_retire()
         else:
             self._idle = True
 
@@ -321,6 +432,8 @@ class SimReplica:
         handoff records adopt straight into rows."""
         sim = self.sim
         busy = 0.0
+        if self.draining:
+            return busy  # draining: finish what we hold, lease nothing new
         if self.role == "decode":
             while len(self.active) < self.rows:
                 rec = self.broker.pop_handoff(timeout=0.0, worker_id=self.wid)

@@ -101,6 +101,10 @@ class FleetSim:
         fleet = spec.get("fleet") or {}
         self.replicas: list[SimReplica] = []
         self.by_wid: dict[str, SimReplica] = {}
+        # Provisioned-replica gauge for the autoscale bench's chip-hours
+        # metric (SimReplica._mark_up/_mark_down drive it).
+        self._alive_now = 0
+        self._peak_alive = 0
         self._build_fleet(fleet)
         # "shared" is the null policy: requests go to the shared queue
         # and any non-decode replica pops them — the baseline arm the
@@ -112,6 +116,22 @@ class FleetSim:
             failover_check_s=float(fleet.get("failover_check_s", 1.0)),
         )
         self.ctrl = self._build_brownout(fleet.get("brownout"))
+        # Fleet controller (serve/controller.py): scenario-driven
+        # autoscaling over the REAL reconciler. Telemetry internals are
+        # initialized even without a controller block so the fault plane
+        # can reference them unconditionally.
+        self._util_prev: dict[str, tuple[float, float]] = {}
+        self._last_telemetry: dict | None = None
+        self._telemetry_stale_until = 0.0
+        self._telemetry_min_dt = 0.5
+        self._ctrl_ttft_target = 0.5
+        self._ctrl_seq = 1
+        self._zombie_controllers: list = []
+        self._ctrl_cfg = fleet.get("controller")
+        self.controller = (
+            self._build_controller(self._ctrl_cfg)
+            if self._ctrl_cfg else None
+        )
         self.poison_respawn_s = float(spec.get("poison_respawn_s", 0.5))
         self.tick_s = float(spec.get("control_tick_s", 0.25))
 
@@ -187,29 +207,42 @@ class FleetSim:
 
     def _build_fleet(self, fleet: dict) -> None:
         groups = fleet.get("replicas") or [{"count": 4, "role": "unified"}]
-        idx: dict[str, int] = collections.defaultdict(int)
+        # Per-role wid counters + group templates persist past
+        # construction: controller spawns continue the numbering and
+        # clone the role's first group's knobs.
+        self._role_idx: dict[str, int] = collections.defaultdict(int)
+        self._role_groups: dict[str, dict] = {}
         for g in groups:
             role = g.get("role", "unified")
-            prefix = _ROLE_PREFIX[role]
+            self._role_groups.setdefault(role, g)
             for _ in range(int(g.get("count", 1))):
-                wid = f"sim-{prefix}{idx[role]:02d}"
-                idx[role] += 1
-                r = SimReplica(
-                    self, wid, role=role,
-                    rows=int(g.get("rows", 8)),
-                    chunk_tokens=int(g.get("chunk_tokens", 16)),
-                    prefill_chunk=int(g.get("prefill_chunk", 64)),
-                    admit_burst=int(g.get("admit_burst", 4)),
-                    heartbeat_s=float(g.get("heartbeat_s", 0.5)),
-                    prefill_mode=g.get("prefill_mode", "chunked"),
-                    prefix_lru_slots=int(g.get("prefix_lru_slots", 0)),
-                    preempt=bool(g.get("preempt", True)),
-                    sized_handoff_payload=bool(
-                        g.get("sized_handoff_payload", False)
-                    ),
-                )
-                self.replicas.append(r)
-                self.by_wid[wid] = r
+                wid = self._next_wid(role)
+                self.checker.note_worker(wid)
+                self._make_replica(wid, role, g)
+
+    def _next_wid(self, role: str) -> str:
+        wid = f"sim-{_ROLE_PREFIX[role]}{self._role_idx[role]:02d}"
+        self._role_idx[role] += 1
+        return wid
+
+    def _make_replica(self, wid: str, role: str, g: dict) -> SimReplica:
+        r = SimReplica(
+            self, wid, role=role,
+            rows=int(g.get("rows", 8)),
+            chunk_tokens=int(g.get("chunk_tokens", 16)),
+            prefill_chunk=int(g.get("prefill_chunk", 64)),
+            admit_burst=int(g.get("admit_burst", 4)),
+            heartbeat_s=float(g.get("heartbeat_s", 0.5)),
+            prefill_mode=g.get("prefill_mode", "chunked"),
+            prefix_lru_slots=int(g.get("prefix_lru_slots", 0)),
+            preempt=bool(g.get("preempt", True)),
+            sized_handoff_payload=bool(
+                g.get("sized_handoff_payload", False)
+            ),
+        )
+        self.replicas.append(r)
+        self.by_wid[wid] = r
+        return r
 
     def _build_brownout(self, b: dict | None):
         if not b:
@@ -238,6 +271,154 @@ class FleetSim:
             check_s=float(b.get("check_s", 1.0)),
             batch_max_new_cap=int(b.get("batch_max_new_cap", 64)),
         )
+
+    def _build_controller(self, c: dict):
+        """The REAL reconciling controller (serve/controller.py) wired
+        to sim actuators: spawns continue the role's wid numbering and
+        clone the role's group knobs; retires drive the replica drain
+        lifecycle. Invariant hooks fire on every actuation so the
+        checker — not the controller's own guards — is what certifies
+        no-duplicate-spawn / drain-before-retire / floor."""
+        from llmss_tpu.serve.controller import FleetController
+
+        roles = sorted({r.role for r in self.replicas}) or ["unified"]
+        cold = float(c.get("cold_start_s", 2.0))
+        self._ctrl_ttft_target = float(c.get("ttft_target_s", 0.5))
+        self._telemetry_min_dt = float(c.get("telemetry_min_dt_s", 0.5))
+        floor = c.get("floor", 1)
+        floor_map = (
+            {r: int(floor.get(r, 1)) for r in roles}
+            if isinstance(floor, dict)
+            else {r: int(floor) for r in roles}
+        )
+
+        def spawn(role: str) -> str:
+            wid = self._next_wid(role)
+            self.checker.on_controller_spawn(wid)
+            r = self._make_replica(wid, role, self._role_groups.get(role, {}))
+            self.counters["ctrl_spawns"] += 1
+            r.spawn(cold_start_s=cold)
+            return wid
+
+        def retire(wid: str) -> None:
+            r = self.by_wid.get(wid)
+            if r is None:
+                return
+            remaining = sum(
+                1 for o in self.replicas
+                if o.role == r.role and o.alive and not o.draining
+            ) - 1
+            self.checker.on_fleet_retire(
+                r.role, remaining, floor_map.get(r.role, 1),
+            )
+            self.checker.on_controller_drain(wid)
+            self.counters["ctrl_retires"] += 1
+            r.retire()
+
+        ctrl = FleetController(
+            self.broker,
+            spawn=spawn, retire=retire,
+            read_telemetry=self._read_telemetry,
+            roles=roles,
+            floor=c.get("floor", 1),
+            ceiling=c.get("ceiling", 8),
+            check_s=float(c.get("check_s", 1.0)),
+            cooldown_s=float(c.get("cooldown_s", 5.0)),
+            dwell_s=float(c.get("dwell_s", 3.0)),
+            cold_start_s=cold,
+            burn_headroom_s=float(c.get("burn_headroom_s", 10.0)),
+            scale_up_burn=float(c.get("scale_up_burn", 1.5)),
+            scale_down_burn=float(c.get("scale_down_burn", 0.5)),
+            backlog_high=float(c.get("backlog_high", 8.0)),
+            backlog_low=float(c.get("backlog_low", 1.0)),
+            util_high=float(c.get("util_high", 0.85)),
+            util_low=float(c.get("util_low", 0.35)),
+            telemetry_max_age_s=float(c.get("telemetry_max_age_s", 5.0)),
+            reshape=bool(c.get("reshape", True)),
+            controller_id=f"sim-ctrl-{self._ctrl_seq}",
+        )
+        self._ctrl_seq += 1
+        return ctrl
+
+    def _read_telemetry(self) -> dict | None:
+        """The controller's signal snapshot: interactive TTFT burn (the
+        same sliding window the brownout ladder reads), total queue +
+        handoff backlog, and per-role mean utilization from windowed
+        busy-seconds deltas (the sim's stand-in for devtel's MFU/MBU —
+        a saturated prefill replica is MFU-bound, a saturated decode
+        replica MBU-bound). Snapshots are memoized for a minimum window
+        so repeated reads within one control interval see one coherent
+        sample; a telemetry_stall fault freezes the last snapshot, whose
+        aging ``ts`` is exactly what the controller's staleness gate
+        watches."""
+        now = self.clock.now
+        if now < self._telemetry_stale_until:
+            return self._last_telemetry
+        last = self._last_telemetry
+        if last is not None and now - last["ts"] < self._telemetry_min_dt:
+            return last
+        util_sum: dict[str, float] = {}
+        util_n: dict[str, int] = {}
+        for r in self.replicas:
+            if not (r.alive or r.spawning):
+                self._util_prev.pop(r.wid, None)
+                continue
+            t0, b0 = self._util_prev.get(r.wid, (now, r.busy_s))
+            dt = now - t0
+            u = min(1.0, (r.busy_s - b0) / dt) if dt > 0 else 0.0
+            self._util_prev[r.wid] = (now, r.busy_s)
+            util_sum[r.role] = util_sum.get(r.role, 0.0) + u
+            util_n[r.role] = util_n.get(r.role, 0) + 1
+        window = self._interactive_ttft
+        burn = (
+            sum(window) / len(window) / self._ctrl_ttft_target
+            if window else 0.0
+        )
+        self._last_telemetry = {
+            "ts": now,
+            "burn": round(burn, 9),
+            "queue_depth": self.broker.queue_depth()
+            + sum(self.broker.routed_depths().values()),
+            "handoff_depth": self.broker.handoff_depth()
+            + sum(self.broker.handoff_depths().values()),
+            "util": {
+                role: round(util_sum[role] / util_n[role], 9)
+                for role in sorted(util_sum)
+            },
+        }
+        return self._last_telemetry
+
+    def _restart_controller(self) -> None:
+        """Crash recovery: a BRAND NEW controller instance (no memory of
+        its predecessor) takes a fresh epoch and reconciles from the
+        registry — the zero-duplicate-spawn path under test."""
+        self.counters["controller_restarts"] += 1
+        ctrl = self._build_controller(self._ctrl_cfg)
+        ctrl.start()
+        self.controller = ctrl
+        self._wire_escalation()
+
+    def _wire_escalation(self) -> None:
+        """Brownout may escalate (shed harder) only when the controller
+        says scaling cannot respond in time; with no controller (never
+        configured, or crashed and not yet restarted) the ladder is
+        ungated — shedding is the only protection left."""
+        if self.ctrl is None:
+            return
+        c = self.controller
+        self.ctrl.escalate_ok = (
+            None if c is None
+            else (lambda: c.escalation_allowed(self.clock.now))
+        )
+
+    # -- hooks SimReplica calls (provisioning gauge) --------------------------
+
+    def on_replica_up(self) -> None:
+        self._alive_now += 1
+        self._peak_alive = max(self._peak_alive, self._alive_now)
+
+    def on_replica_down(self) -> None:
+        self._alive_now -= 1
 
     def _attach_collector(self, broker) -> None:
         """Pop every settled response out of the broker's buffer the
@@ -334,16 +515,44 @@ class FleetSim:
         deadlines = wl.get("deadline_s") or {}
         poison_every = int(wl.get("poison_every", 0))
         sessions = int(wl.get("sessions", 0))
+        # Diurnal shaping: piecewise-constant rate multipliers
+        # [[t_s, mult], ...] — rate_rps is the baseline, each breakpoint
+        # rescales it from t_s on. Draw COUNT is unchanged (the
+        # expovariate just gets a different rate), so profiled and flat
+        # runs consume the RNG identically.
+        prof = sorted(
+            (float(t), float(m)) for t, m in (wl.get("rate_profile") or ())
+        ) or None
+        # Heavy tail: with probability p a request's max_new multiplies
+        # by ``mult`` (capped) — the occasional long generation that
+        # makes diurnal autoscaling hard.
+        ht = wl.get("heavy_tail")
         rng = self.rng
+
+        def rate_at(t: float) -> float:
+            m = 1.0
+            if prof:
+                for ts, mult in prof:
+                    if t >= ts:
+                        m = mult
+                    else:
+                        break
+            return max(rate * m, 1e-6)
 
         def make(i: int) -> GenerateRequest:
             plen = rng.randint(int(p_lo), int(p_hi))
             ids = [rng.randrange(1, 50_000) for _ in range(plen)]
             u = rng.random() * acc
             slo = next((c for a, c in cdf if u <= a), cdf[-1][1])
+            mnew = rng.randint(int(m_lo), int(m_hi))
+            if ht is not None and rng.random() < float(ht.get("p", 0.05)):
+                mnew = min(
+                    int(mnew * float(ht.get("mult", 8.0))),
+                    int(ht.get("cap", 512)),
+                )
             req = GenerateRequest(
                 token_ids=ids,
-                max_new_tokens=rng.randint(int(m_lo), int(m_hi)),
+                max_new_tokens=mnew,
                 slo_class=slo,
                 id=f"s{i:08d}",
             )
@@ -364,10 +573,11 @@ class FleetSim:
         def pump(i: int):
             self._submit(make(i))
             if i + 1 < n:
+                r_now = rate_at(self.clock.now)
                 if arrival == "uniform":
-                    dt = 1.0 / rate
+                    dt = 1.0 / r_now
                 else:
-                    dt = rng.expovariate(rate)
+                    dt = rng.expovariate(r_now)
                 self.loop.call_after(dt, lambda: pump(i + 1))
             else:
                 self._arrivals_done = True
@@ -597,6 +807,46 @@ class FleetSim:
                     r.kill(respawn_after_s=respawn)
 
             self.loop.call_at(at_s, fire_storm)
+        elif kind == "controller_crash":
+            # Kill the fleet controller. Default: it simply stops ticking
+            # (a true crash) and a BRAND NEW instance restarts after
+            # ``restart_after_s`` (None = never), reconciling from the
+            # registry. ``zombie: true`` keeps the dead controller
+            # ticking alongside its successor — a partitioned leader
+            # that still thinks it leads — so every actuation it plans
+            # must die at the epoch fence.
+            restart_after = f.get("restart_after_s", 2.0)
+            zombie = bool(f.get("zombie", False))
+
+            def fire_crash():
+                old = self.controller
+                if old is None:
+                    return
+                self.counters["controller_crashes"] += 1
+                if zombie:
+                    self._zombie_controllers.append(old)
+                self.controller = None
+                self._wire_escalation()
+                if restart_after is not None:
+                    self.loop.call_after(
+                        float(restart_after), self._restart_controller,
+                    )
+
+            self.loop.call_at(at_s, fire_crash)
+        elif kind == "telemetry_stall":
+            # Freeze the telemetry snapshot: reads keep returning the
+            # last payload with its aging ``ts`` (or None if nothing was
+            # ever sampled). The controller's staleness gate must hold
+            # position for the whole window.
+            dur = float(f.get("duration_s", 5.0))
+
+            def fire_tstall():
+                self._telemetry_stale_until = max(
+                    self._telemetry_stale_until, self.clock.now + dur,
+                )
+                self.counters["telemetry_stalls"] += 1
+
+            self.loop.call_at(at_s, fire_tstall)
         else:
             raise ValueError(f"unknown fault kind {kind!r}")
 
@@ -608,6 +858,12 @@ class FleetSim:
             self.router.check_failover()
         if self.ctrl is not None:
             self.ctrl.tick()
+        if self.controller is not None:
+            self.controller.tick(now=self.clock.now)
+        for z in self._zombie_controllers:
+            # A fenced zombie may tick forever; every action it plans
+            # must be a no-op (asserted via its ``fenced`` counter).
+            z.tick(now=self.clock.now)
         for r in self.replicas:
             if r.alive and r._idle and self.has_work(r):
                 r.nudge()
@@ -642,6 +898,14 @@ class FleetSim:
         trace.set_enabled(False)
         try:
             with self.clock.installed():
+                if self.ctrl is not None:
+                    # Built on the REAL clock in __init__ — re-anchor its
+                    # history epoch to virtual t=0 so transition ``at_s``
+                    # stamps are virtual-time (deterministic) quantities.
+                    self.ctrl._since = 0.0
+                if self.controller is not None:
+                    self.controller.start()
+                    self._wire_escalation()
                 for r in self.replicas:
                     r.start()
                 self._install_faults()
@@ -701,6 +965,9 @@ class FleetSim:
             "cost_model": self.cost.describe(),
         }
         if self.per_class:
+            slo_targets = (self.spec.get("metrics") or {}).get(
+                "ttft_slo_s"
+            ) or {}
             out["classes"] = {
                 cls: {
                     "offered": self._cls_offered[cls],
@@ -714,6 +981,41 @@ class FleetSim:
                         sorted(self._cls_ttft[cls]), 0.99) * 1e3, 6),
                 }
                 for cls in sorted(self._cls_offered)
+            }
+            # Per-class TTFT SLO attainment (metrics.ttft_slo_s targets):
+            # fraction of completed requests under the class's target —
+            # the equal-or-better bar the autoscale bench holds both
+            # arms to.
+            for cls, entry in out["classes"].items():
+                t = slo_targets.get(cls)
+                if t is None:
+                    continue
+                vals = self._cls_ttft[cls]
+                entry["ttft_attainment"] = (
+                    round(sum(1 for v in vals if v <= float(t)) / len(vals), 6)
+                    if vals else None
+                )
+        if self._ctrl_cfg is not None:
+            now = self.clock.now
+            fenced = sum(
+                z.counters["fenced"] for z in self._zombie_controllers
+            )
+            out["fleet"] = {
+                "replicas_end": sum(1 for r in self.replicas if r.alive),
+                "peak_alive": self._peak_alive,
+                "replica_seconds": round(
+                    sum(r.alive_seconds(now) for r in self.replicas), 6,
+                ),
+                "spawns": self.counters["ctrl_spawns"],
+                "retires": self.counters["ctrl_retires"],
+                "zombie_fenced": fenced,
+                "controller": (
+                    self.controller.state()
+                    if self.controller is not None else None
+                ),
+                "brownout": (
+                    self.ctrl.state() if self.ctrl is not None else None
+                ),
             }
         return out
 

@@ -446,6 +446,30 @@ def merged_gauges(exports) -> dict:
     return out
 
 
+def phase_utilization(exports=None) -> dict:
+    """Per-phase utilization signal for the fleet controller.
+
+    Prefill saturates FLOPs (MFU) while decode saturates HBM bandwidth
+    (MBU) — the asymmetry that motivates P:D ratio tuning — so the
+    controller steers prefill capacity on the hottest MFU gauge and
+    decode capacity on the hottest MBU gauge. Reads the in-process
+    gauges by default, or a list of devtel export blobs when aggregating
+    across replicas. Missing gauges read 0.0 (no signal, not "idle" —
+    the controller's hysteresis treats 0 as no pressure either way)."""
+    if exports is not None:
+        g = merged_gauges(exports)
+        mfu = [v for v in g["mfu"].values() if v is not None]
+        mbu = [v for v in g["mbu"].values() if v is not None]
+    else:
+        lu = last_util()
+        mfu = [g["mfu"] for g in lu.values() if g.get("mfu") is not None]
+        mbu = [g["mbu"] for g in lu.values() if g.get("mbu") is not None]
+    return {
+        "prefill": max(mfu) if mfu else 0.0,
+        "decode": max(mbu) if mbu else 0.0,
+    }
+
+
 # -- compile forensics --------------------------------------------------------
 
 

@@ -1,0 +1,801 @@
+"""Fleet autoscaler (serve/controller.py): the control loop, closed.
+
+Unit tests drive ``FleetController.tick(now=...)`` on a virtual
+timeline against a real broker registry, so every robustness property
+is asserted directly:
+
+- **epoch fencing**: a controller that lost leadership plans actions
+  but actuates nothing — every call dies at the broker fence;
+- **crash + restart reconciliation**: a fresh controller instance
+  counts still-cold-starting replicas as observed capacity, so a
+  restart never double-spawns;
+- **do-no-harm**: floor, last-routable, cooldown, and stale-telemetry
+  holds each block the exact actuation they exist to block;
+- **hysteresis + dwell**: pressure that appears and vanishes within
+  the dwell window never moves the fleet;
+- **scale-before-shed**: the escalation contract the brownout ladder
+  consults — shedding only when scaling structurally cannot respond.
+
+Integration tests run the real serving stack on BOTH delivery
+substrates (InProcBroker and RedisBroker over FakeRedis): the
+supervisor's last-routable drain guard, and a controller-retired
+replica releasing its leases as refunds (no redelivery, no consumed
+attempt, never swept by failover). Sim tests replay a small diurnal
+autoscale scenario byte-identically and crash the controller mid-climb.
+"""
+
+import copy
+import json
+import threading
+import time
+
+import pytest
+
+from llmss_tpu.serve.broker import InProcBroker, RedisBroker
+from llmss_tpu.serve.chaos import FakeRedis, ScriptedEngine
+from llmss_tpu.serve.consumer import Worker
+from llmss_tpu.serve.controller import FleetController
+from llmss_tpu.serve.producer import QueueDrainEstimator, admission_verdict
+from llmss_tpu.serve.protocol import STATE_DEAD, GenerateRequest
+from llmss_tpu.serve.supervisor import Supervisor
+from llmss_tpu.sim import run_scenario
+from llmss_tpu.sim.invariants import InvariantChecker
+
+BROKER_KINDS = ("inproc", "fakeredis")
+
+
+def make_brokers(kind, *, lease_s=5.0, max_attempts=6, n_workers=1):
+    """(producer_broker, [worker_broker, ...]) on one substrate."""
+    if kind == "inproc":
+        b = InProcBroker(lease_s=lease_s, max_delivery_attempts=max_attempts)
+        return b, [b] * n_workers
+    server = FakeRedis()
+
+    def mk(wid):
+        return RedisBroker(
+            client=server, worker_id=wid, lease_s=lease_s,
+            max_delivery_attempts=max_attempts,
+        )
+
+    return mk("producer"), [mk(f"worker{i}") for i in range(n_workers)]
+
+
+# -- unit-test scaffolding ----------------------------------------------------
+
+
+class Tel:
+    """Mutable telemetry source the tests steer tick by tick."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.burn = 1.0
+        self.depth = 0
+        self.util: dict = {}
+        self.down = False
+        self.ts_lag = 0.0
+
+    def read(self):
+        if self.down:
+            return None
+        return {
+            "ts": self.now - self.ts_lag, "burn": self.burn,
+            "queue_depth": self.depth, "handoff_depth": 0,
+            "util": dict(self.util),
+        }
+
+
+def put_worker(broker, wid, *, role="unified", state="ready",
+               hb_age=0.0, alive=True, hb_s=1.0):
+    broker.publish_worker_load(wid, {
+        "role": role, "state": state, "alive": alive,
+        "heartbeat_ts": time.time() - hb_age, "heartbeat_s": hb_s,
+    })
+
+
+def make_ctrl(broker, tel, *, spawned=None, retired=None, **kw):
+    """Controller with recording actuators; spawns register as starting."""
+    spawned = spawned if spawned is not None else []
+    retired = retired if retired is not None else []
+
+    def spawn(role):
+        wid = f"new-{len(spawned)}"
+        spawned.append((role, wid))
+        put_worker(broker, wid, role=role, state="starting")
+        return wid
+
+    def retire(wid):
+        retired.append(wid)
+        put_worker(broker, wid, state="draining")
+
+    kw.setdefault("check_s", 0.5)
+    kw.setdefault("cooldown_s", 1.0)
+    kw.setdefault("dwell_s", 1.0)
+    kw.setdefault("cold_start_s", 1.0)
+    kw.setdefault("burn_headroom_s", 10.0)
+    kw.setdefault("floor", 1)
+    kw.setdefault("ceiling", 4)
+    ctrl = FleetController(
+        broker, spawn=spawn, retire=retire, read_telemetry=tel.read, **kw,
+    )
+    return ctrl, spawned, retired
+
+
+def drive(ctrl, tel, t0, t1, step=0.5):
+    """Tick the controller over [t0, t1]; returns the actions taken."""
+    actions = []
+    t = t0
+    while t <= t1 + 1e-9:
+        tel.now = t
+        a = ctrl.tick(now=t)
+        if a is not None:
+            actions.append(dict(a, t=t))
+        t += step
+    return actions
+
+
+# -- scaling, hysteresis, holds ----------------------------------------------
+
+
+def test_scale_up_on_sustained_burn():
+    broker = InProcBroker()
+    put_worker(broker, "w0")
+    tel = Tel()
+    ctrl, spawned, _ = make_ctrl(broker, tel)
+    ctrl.start()
+
+    tel.burn = 2.5  # hot, sustained
+    acts = drive(ctrl, tel, 0.0, 1.0)
+    assert [(a["kind"], a["role"]) for a in acts] == [("spawn", "unified")]
+    assert spawned == [("unified", "new-0")]
+    # Dwell was respected: no action before a full dwell_s of pressure.
+    assert acts[0]["t"] >= ctrl.dwell_s
+
+
+def test_scale_down_retires_to_floor_and_stops():
+    broker = InProcBroker()
+    for wid in ("w0", "w1", "w2"):
+        put_worker(broker, wid)
+    tel = Tel()
+    ctrl, _, retired = make_ctrl(broker, tel)
+    ctrl.start()
+
+    tel.burn = 0.1  # cold and idle
+    drive(ctrl, tel, 0.0, 12.0)
+    # Retired down to the floor (1) and NOT past it: one replica of the
+    # role must always remain, however long the quiet lasts.
+    assert retired == ["w2", "w1"]  # LIFO: newest first
+    assert ctrl.counters["retires"] == 2
+    # The actuation-time guard backstops the planner against the
+    # registry shrinking between plan and act (e.g. a concurrent kill).
+    obs = ctrl.observe()
+    assert obs["unified"]["ready"] == 1
+    assert ctrl._guard({"kind": "retire", "role": "unified"}, obs) == "floor"
+    assert ctrl.counters["blocked_floor"] == 1
+
+
+def test_flapping_pressure_never_moves_the_fleet():
+    broker = InProcBroker()
+    put_worker(broker, "w0")
+    tel = Tel()
+    ctrl, spawned, retired = make_ctrl(broker, tel)
+    ctrl.start()
+
+    # Burn alternates hot/neutral every tick: always below dwell.
+    t = 0.0
+    while t <= 10.0:
+        tel.now = t
+        tel.burn = 2.5 if int(t * 2) % 2 == 0 else 1.0
+        ctrl.tick(now=t)
+        t += 0.5
+    assert spawned == [] and retired == []
+    assert ctrl.counters["ticks"] > 0
+
+
+def test_stale_telemetry_holds_and_resets_dwell():
+    broker = InProcBroker()
+    put_worker(broker, "w0")
+    tel = Tel()
+    ctrl, spawned, _ = make_ctrl(broker, tel)
+    ctrl.start()
+
+    tel.burn = 2.5
+    drive(ctrl, tel, 0.0, 0.5)      # pressure building, not yet dwelled
+    tel.down = True
+    drive(ctrl, tel, 1.0, 1.5)      # telemetry plane dies mid-dwell
+    assert ctrl.counters["held_stale"] == 2
+    assert spawned == []
+    tel.down = False
+    # Pressure must re-prove itself on fresh data: a spawn at t=2.0
+    # would mean the pre-outage dwell credit survived the hold.
+    acts = drive(ctrl, tel, 2.0, 3.5)
+    assert spawned != []
+    assert acts[0]["t"] >= 2.0 + ctrl.dwell_s
+
+
+def test_stale_ts_field_is_a_hold_too():
+    broker = InProcBroker()
+    put_worker(broker, "w0")
+    tel = Tel()
+    ctrl, spawned, _ = make_ctrl(broker, tel, telemetry_max_age_s=2.0)
+    ctrl.start()
+    tel.burn = 2.5
+    tel.ts_lag = 10.0  # snapshots exist but are ancient
+    drive(ctrl, tel, 0.0, 3.0)
+    assert spawned == []
+    assert ctrl.counters["held_stale"] == 7
+
+
+def test_cooldown_allows_one_actuation_per_window():
+    broker = InProcBroker()
+    put_worker(broker, "w0")
+    tel = Tel()
+    ctrl, spawned, _ = make_ctrl(broker, tel, cooldown_s=6.0)
+    ctrl.start()
+
+    tel.burn = 3.0  # hot the whole time
+    acts = drive(ctrl, tel, 0.0, 11.0)
+    # First spawn at dwell (t=1.0); the window [1.0, 7.0) admits no
+    # second actuation however hot the signal stays.
+    assert len(acts) == 2
+    assert acts[1]["t"] - acts[0]["t"] >= 6.0
+    assert ctrl.counters["held_cooldown"] > 0
+    assert [r for r, _ in spawned] == ["unified", "unified"]
+
+
+def test_never_drains_last_routable_even_with_zero_floor():
+    broker = InProcBroker()
+    put_worker(broker, "w0")
+    tel = Tel()
+    ctrl, _, retired = make_ctrl(broker, tel, floor=0)
+    ctrl.start()
+    tel.burn = 0.0
+    drive(ctrl, tel, 0.0, 6.0)
+    assert retired == []
+    # And even if a retire were forced through the planner, the guard
+    # refuses to take the role to zero regardless of the floor.
+    obs = ctrl.observe()
+    assert ctrl._guard(
+        {"kind": "retire", "role": "unified"}, obs
+    ) == "last-routable"
+    assert ctrl.counters["blocked_last_routable"] == 1
+
+
+def test_ceiling_blocks_spawn():
+    broker = InProcBroker()
+    for wid in ("w0", "w1"):
+        put_worker(broker, wid)
+    tel = Tel()
+    ctrl, spawned, _ = make_ctrl(broker, tel, ceiling=2)
+    ctrl.start()
+    tel.burn = 3.0
+    drive(ctrl, tel, 0.0, 6.0)
+    assert spawned == []
+    assert ctrl.counters["blocked_ceiling"] > 0
+
+
+# -- observation: registry staleness ------------------------------------------
+
+
+def test_observe_skips_dead_and_stale_rows():
+    broker = InProcBroker()
+    put_worker(broker, "fresh")
+    put_worker(broker, "killed", hb_age=60.0)  # snapshot frozen at ready
+    put_worker(broker, "tombstone", alive=False)
+    put_worker(broker, "starting", state="starting")
+    tel = Tel()
+    ctrl, _, _ = make_ctrl(broker, tel)
+    obs = ctrl.observe()
+    assert obs["unified"]["ready"] == 1
+    assert obs["unified"]["ready_ids"] == ["fresh"]
+    assert obs["unified"]["starting"] == 1
+    # A hard-killed replica's last snapshot says "ready" forever; only
+    # the heartbeat age tells the truth. Counting it would both block
+    # scale-up at a phantom ceiling and hide the need to replace it.
+    assert ctrl._live(obs, "unified") == 2
+
+
+# -- epoch fencing + crash/restart reconciliation -----------------------------
+
+
+def test_stale_epoch_controller_is_fully_fenced():
+    broker = InProcBroker()
+    put_worker(broker, "w0")
+    tel = Tel()
+    old, old_spawned, old_retired = make_ctrl(
+        broker, tel, controller_id="old",
+    )
+    old.start()
+    new, new_spawned, _ = make_ctrl(broker, tel, controller_id="new")
+    new.start()  # bumps the epoch: "old" is now a zombie
+    assert broker.controller_holder() == "new"
+
+    tel.burn = 3.0
+    drive(old, tel, 0.0, 5.0)
+    # The zombie planned spawns every cooldown — and actuated nothing.
+    assert old_spawned == [] and old_retired == []
+    assert old.counters["fenced"] > 0
+
+    acts = drive(new, tel, 5.5, 7.0)
+    assert new_spawned != [] and acts
+
+
+def test_crash_restart_never_duplicates_inflight_spawns():
+    broker = InProcBroker()
+    put_worker(broker, "w0")
+    tel = Tel()
+    first, spawned, _ = make_ctrl(broker, tel, ceiling=2)
+    first.start()
+    tel.burn = 3.0
+    drive(first, tel, 0.0, 1.0)
+    assert len(spawned) == 1  # cold-starting, registered as "starting"
+
+    # Controller crashes; a brand-new instance (no in-memory state)
+    # reconciles purely from the registry.
+    second, spawned2, _ = make_ctrl(broker, tel, ceiling=2)
+    second.start()
+    drive(second, tel, 2.0, 8.0)
+    # The in-flight spawn counts as observed capacity: at ceiling 2
+    # (1 ready + 1 starting) the restart spawns NOTHING.
+    assert spawned2 == []
+    assert second.counters["blocked_ceiling"] > 0
+    obs = second.observe()
+    assert obs["unified"]["starting"] == 1
+
+
+# -- escalation contract (scale-before-shed) ----------------------------------
+
+
+def test_escalation_suppressed_while_scaling_can_respond():
+    broker = InProcBroker()
+    put_worker(broker, "w0")
+    tel = Tel()
+    ctrl, _, _ = make_ctrl(
+        broker, tel, cold_start_s=2.0, burn_headroom_s=10.0,
+    )
+    ctrl.start()
+    tel.now = 1.0
+    assert ctrl.escalation_allowed(now=1.0) is False
+    assert ctrl.counters["escalations_suppressed"] == 1
+
+
+def test_escalation_allowed_when_cold_start_exceeds_headroom():
+    broker = InProcBroker()
+    put_worker(broker, "w0")
+    tel = Tel()
+    ctrl, _, _ = make_ctrl(
+        broker, tel, cold_start_s=30.0, burn_headroom_s=10.0,
+    )
+    ctrl.start()
+    tel.now = 1.0
+    # Reinforcement cannot arrive inside the burn window no matter when
+    # it was ordered: shedding is the only lever that works in time.
+    assert ctrl.escalation_allowed(now=1.0) is True
+    assert ctrl.counters["escalations_allowed"] == 1
+
+
+def test_escalation_allowed_at_ceiling_and_when_blind():
+    broker = InProcBroker()
+    put_worker(broker, "w0")
+    put_worker(broker, "w1", state="starting")
+    tel = Tel()
+    ctrl, _, _ = make_ctrl(
+        broker, tel, ceiling=2, cold_start_s=2.0, burn_headroom_s=10.0,
+    )
+    ctrl.start()
+    tel.now = 1.0
+    # At ceiling — counting the cold-starting spawn — there is no
+    # capacity left to add.
+    assert ctrl.escalation_allowed(now=1.0) is True
+    # Blind controller must not pin brownout down.
+    tel.down = True
+    assert ctrl.escalation_allowed(now=2.0) is True
+    assert ctrl.counters["escalations_allowed"] == 2
+
+
+# -- P:D reshaping ------------------------------------------------------------
+
+
+def test_reshape_spawns_before_retiring_donor():
+    broker = InProcBroker()
+    for wid in ("p0", "p1"):
+        put_worker(broker, wid, role="prefill")
+    for wid in ("d0", "d1"):
+        put_worker(broker, wid, role="decode")
+    tel = Tel()
+    ctrl, spawned, retired = make_ctrl(
+        broker, tel, roles=("prefill", "decode"),
+        floor={"prefill": 1, "decode": 1},
+    )
+    ctrl.start()
+
+    # Prefill saturated (MFU-bound) while decode idles: the fleet's
+    # P:D ratio is wrong for the offered phase mix.
+    tel.util = {"prefill": 0.95, "decode": 0.1}
+    acts = drive(ctrl, tel, 0.0, 2.0)
+    assert [(a["kind"], a["role"]) for a in acts] == [
+        ("reshape-spawn", "prefill"),
+    ]
+    assert ctrl.state()["reshape_debt"] == "decode"
+    assert retired == []  # spawn strictly first: capacity never dips
+
+    # The spawned prefill replica comes ready; the donor retirement debt
+    # settles on a later tick.
+    put_worker(broker, spawned[0][1], role="prefill")
+    tel.util = {}
+    acts = drive(ctrl, tel, 2.5, 5.0)
+    assert [(a["kind"], a["role"]) for a in acts] == [
+        ("reshape-retire", "decode"),
+    ]
+    assert retired == ["d1"]
+    assert ctrl.counters["reshape_spawns"] == 1
+    assert ctrl.counters["reshape_retires"] == 1
+
+
+# -- invariant catalog items 7-9 ----------------------------------------------
+
+
+def test_checker_flags_duplicate_spawn_and_unordered_retire():
+    ic = InvariantChecker()
+    ic.note_worker("w0")
+    ic.on_controller_spawn("w1")
+    ic.on_controller_drain("w1")
+    ic.on_controller_retired("w1")
+    assert ic._violations == []
+
+    ic.on_controller_spawn("w0")  # duplicate of the seed fleet
+    ic.on_controller_retired("w2")  # never drained
+    ic.on_fleet_retire("unified", remaining=0, floor=1)
+    msgs = "\n".join(ic._violations)
+    assert "duplicate worker_id" in msgs
+    assert "without a preceding drain" in msgs
+    assert "below floor" in msgs
+    assert len(ic._violations) == 3
+
+
+# -- satellite: honest Retry-After from the queue drain rate ------------------
+
+
+def test_retry_after_tracks_queue_drain_rate():
+    est = QueueDrainEstimator(window_s=30.0, min_s=1, max_s=30)
+    assert est.retry_after_s(50, now=0.0) == 1  # no signal: legacy 1s
+
+    # 20 admissions over 10s while depth stays flat: service rate 2/s.
+    for i in range(21):
+        est.note_admitted(depth=10, now=float(i) / 2.0)
+    assert est.retry_after_s(10, now=10.0) == 5    # 10 / (2/s)
+    assert est.retry_after_s(30, now=10.0) == 15   # deeper -> longer
+    assert est.retry_after_s(2, now=10.0) == 1     # shallow -> clamp floor
+
+    # Queue grew faster than admissions: nothing is draining — back off
+    # to the max rather than inviting a thundering herd in 1s.
+    est2 = QueueDrainEstimator(window_s=30.0, max_s=30)
+    est2.note_admitted(depth=0, now=0.0)
+    est2.note_admitted(depth=50, now=5.0)
+    assert est2.retry_after_s(50, now=5.0) == 30
+
+
+def test_admission_verdict_derives_retry_after_from_estimator():
+    broker = InProcBroker()
+    for i in range(8):
+        broker.push_request(GenerateRequest(
+            id=f"q{i}", token_ids=[1], max_new_tokens=1,
+        ))
+    est = QueueDrainEstimator()
+    for i in range(11):
+        est.note_admitted(depth=8, now=float(i))  # 1 req/s service rate
+    req = GenerateRequest(id="shed-me", token_ids=[1], max_new_tokens=1)
+
+    verdict = admission_verdict(req, broker, max_queue_depth=4, drain=est)
+    assert verdict is not None
+    status, body, headers = verdict
+    assert status == 429 and body["queue_depth"] == 8
+    assert headers["Retry-After"] == str(est.retry_after_s(8))
+    assert int(headers["Retry-After"]) >= 8  # 8 deep at ~1/s
+
+    # Without an estimator the legacy constant stands.
+    _, _, h = admission_verdict(req, broker, max_queue_depth=4)
+    assert h["Retry-After"] == "1"
+
+
+# -- satellite: last-routable drain guard (both substrates) -------------------
+
+
+def _supervised(engine, wb, worker_id):
+    def factory():
+        return Worker(
+            engine, wb, batch_size=2, poll_timeout_s=0.02, pad_batch=False,
+            worker_id=worker_id,
+        )
+
+    sup = Supervisor(factory, wb, backoff_s=0.01, heartbeat_s=0.05)
+    stop = threading.Event()
+    t = threading.Thread(target=sup.run, args=(stop,), daemon=True)
+    t.start()
+    return sup, t
+
+
+def _wait_routable(prod, wid, timeout_s=10.0):
+    from llmss_tpu.serve.fleet import routable_workers
+
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if wid in routable_workers(prod):
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"{wid} never became routable")
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_drain_guard_blocks_last_routable_until_forced(kind):
+    prod, (wb,) = make_brokers(kind)
+    sup, t = _supervised(ScriptedEngine(), wb, "guard-zz")
+    try:
+        _wait_routable(prod, "guard-zz")
+        # The only routable replica: draining it takes the fleet to zero.
+        assert sup.drain(timeout_s=5.0) is False
+        assert not sup.draining
+        info = prod.read_workers()["guard-zz"]
+        assert "last routable" in info["drain_blocked"]
+        # Deliberate teardown stays possible.
+        assert sup.drain(timeout_s=5.0, force=True) is True
+        t.join(timeout=20.0)
+        assert not t.is_alive()
+        assert prod.read_workers()["guard-zz"]["state"] == STATE_DEAD
+    finally:
+        sup.drain(force=True)
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_drain_guard_allows_with_routable_peer(kind):
+    prod, (wb1, wb2) = make_brokers(kind, n_workers=2)
+    sup, t = _supervised(ScriptedEngine(), wb1, "guard-zz")
+    try:
+        _wait_routable(prod, "guard-zz")
+        # A second routable replica of the same role makes the drain safe
+        # (construction registers it ready with a fresh heartbeat).
+        Worker(
+            ScriptedEngine(), wb2, batch_size=2, poll_timeout_s=0.02,
+            pad_batch=False, worker_id="guard-aa",
+        )
+        _wait_routable(prod, "guard-aa")
+        assert sup.drain(timeout_s=5.0) is True
+        t.join(timeout=20.0)
+        assert not t.is_alive()
+    finally:
+        sup.drain(force=True)
+
+
+# -- satellite: controller retirement releases leases as refunds --------------
+
+
+@pytest.fixture(scope="module")
+def tiny_engine(devices):
+    import jax
+
+    from llmss_tpu.engine import DecodeEngine
+    from llmss_tpu.models.common import DecoderConfig
+    from llmss_tpu.models.decoder import init_params
+    from llmss_tpu.parallel import MeshPlan, make_mesh
+
+    mesh = make_mesh(MeshPlan(dp=1, sp=1, tp=8))
+    cfg = DecoderConfig(
+        model_type="llama", vocab_size=128, hidden_size=32, n_layers=1,
+        n_heads=4, n_kv_heads=4, head_dim=8, intermediate_size=64,
+        max_position_embeddings=64, activation="silu", norm="rmsnorm",
+        norm_eps=1e-5, mlp="swiglu", positions="rotary", rope_style="half",
+        rotary_dim=8, attn_bias=False, mlp_bias=False,
+        tie_word_embeddings=False, dtype="float32",
+    )
+    params = init_params(cfg, mesh, jax.random.key(0))
+    return DecodeEngine(cfg, params, mesh, max_seq_len=32)
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_controller_retire_drains_and_refunds_leases(kind, tiny_engine):
+    """A replica the controller retires while it holds leased work must
+    give that work back as a REFUND: no redelivery counted, no delivery
+    attempt consumed (max_attempts=1 would dead-letter any), and the
+    failover sweeper never touches it — draining is not dying."""
+    from llmss_tpu.serve.consumer import ContinuousWorker
+    from llmss_tpu.serve.fleet import Router
+
+    prod, (wb1, wb2) = make_brokers(kind, max_attempts=1, n_workers=2)
+    w_old = ContinuousWorker(
+        tiny_engine, wb1, rows=2, poll_timeout_s=0.0, chunk_steps=2,
+        worker_id="ret-zz",
+    )
+    w_new = ContinuousWorker(
+        tiny_engine, wb2, rows=2, poll_timeout_s=0.0, chunk_steps=2,
+        worker_id="ret-aa",
+    )
+    reqs = [
+        GenerateRequest(
+            id=f"ret{i}", token_ids=[1 + i, 2], max_new_tokens=3,
+            is_greedy=True,
+        )
+        for i in range(6)
+    ]
+    for r in reqs:
+        prod.push_request(r)
+    w_old.run_once()  # leases everything: 2 active rows + 4 pending
+    assert prod.queue_depth() == 0
+
+    retire_calls = []
+
+    def retire(wid):
+        retire_calls.append(wid)
+        w_old.begin_drain()
+        released = w_old.release_pending()
+        assert released == 4, "leased-not-started work must be refunded"
+
+    # The first run_once paid the XLA compile (tens of wall seconds), so
+    # both construction-time heartbeats are stale by now — refresh them,
+    # exactly as the serving loop's periodic publisher would have.
+    w_old._publish_load()
+    w_new._publish_load()
+
+    tel = Tel()
+    tel.burn = 0.1  # cold: the controller wants to shrink the fleet
+    ctrl = FleetController(
+        prod, spawn=lambda role: "never", retire=retire,
+        read_telemetry=tel.read, floor=1, ceiling=4, check_s=0.5,
+        cooldown_s=1.0, dwell_s=1.0,
+    )
+    ctrl.start()
+    drive(ctrl, tel, 0.0, 2.0)
+    # LIFO retire of the sorted registry: ret-zz (the lease holder).
+    assert retire_calls == ["ret-zz"]
+
+    # Mid-drain, with leases still held: the failover sweeper must leave
+    # the draining worker alone — its heartbeat is fresh and its leases
+    # are renewed; only DEAD capacity gets evacuated.
+    router = Router(prod, policy="least_loaded")
+    assert router.check_failover(force=True) == 0
+    assert router.stats()["failover_reroutes"] == 0
+
+    # The drain finishes its two active rows cleanly...
+    deadline = time.time() + 120.0
+    while not w_old.batcher.idle and time.time() < deadline:
+        w_old.run_once()
+    assert w_old.drained
+
+    # ...and the refunded four are served by the surviving replica.
+    got = {}
+    while len(got) < len(reqs) and time.time() < deadline:
+        w_new.run_once()
+        for r in reqs:
+            if r.id not in got:
+                resp = prod.wait_response(r.id, timeout=0.001)
+                if resp is not None:
+                    got[r.id] = resp
+    assert set(got) == {r.id for r in reqs}
+    for rid, resp in got.items():
+        assert resp.error is None, (rid, resp.error)
+
+    stats = prod.delivery_stats()
+    assert stats.get("redelivered", 0) == 0
+    assert stats.get("inflight", 0) == 0
+    # max_delivery_attempts=1: had the refund consumed an attempt, every
+    # re-leased request would have dead-lettered instead of serving.
+    assert prod.read_dlq(limit=100) == []
+
+
+# -- sim: closed-loop autoscale scenarios -------------------------------------
+
+
+def autoscale_spec(broker_kind="inproc", seed=5, **over):
+    """Small diurnal surge: 1 replica cannot carry the peak, 4 can."""
+    spec = {
+        "format": "llmss-scenario/1",
+        "name": f"autoscale-{broker_kind}",
+        "seed": seed,
+        "duration_s": 600.0,
+        "broker": {
+            "kind": broker_kind, "lease_s": 2.0, "max_delivery_attempts": 8,
+        },
+        "cost_model": {
+            "kind": "table", "decode_step_s": 0.02,
+            "prefill_token_s": 0.0002,
+        },
+        "fleet": {
+            "replicas": [{"count": 1, "role": "unified", "rows": 4}],
+            "router_policy": "least_loaded",
+            "failover_check_s": 1.0,
+            "controller": {
+                "floor": 1, "ceiling": 4, "cold_start_s": 1.0,
+                "check_s": 0.5, "cooldown_s": 2.0, "dwell_s": 1.0,
+                "burn_headroom_s": 10.0, "scale_up_burn": 1.2,
+                "scale_down_burn": 0.4, "backlog_high": 2.0,
+                "backlog_low": 0.4, "ttft_target_s": 0.5,
+            },
+        },
+        "workload": {
+            "kind": "synthetic", "requests": 850, "rate_rps": 3.0,
+            "arrival": "poisson", "prompt_len": [8, 24],
+            "max_new": [16, 48],
+            "classes": {"interactive": 0.3, "standard": 0.7},
+            "rate_profile": [
+                [0, 0.5], [20, 2.5], [60, 3.0], [100, 1.0], [130, 0.4],
+            ],
+        },
+        "metrics": {"per_class": True},
+    }
+    spec.update(over)
+    return spec
+
+
+def run_twice(spec):
+    a = json.dumps(run_scenario(copy.deepcopy(spec)), sort_keys=True)
+    b = json.dumps(run_scenario(copy.deepcopy(spec)), sort_keys=True)
+    assert a == b, "same-seed autoscale replay diverged"
+    return json.loads(a)
+
+
+def test_sim_autoscale_deterministic_and_scales():
+    r = run_twice(autoscale_spec())
+    fl = r["fleet"]
+    assert r["invariants"]["violations"] == 0
+    assert r["requests"]["ok"] == r["requests"]["submitted"]
+    # The controller actually worked the trace: grew into the surge,
+    # shrank back after it, and never breached its envelope.
+    assert fl["spawns"] > 0 and fl["retires"] > 0
+    assert 1 <= fl["replicas_end"] <= fl["peak_alive"] <= 4
+    assert fl["peak_alive"] > 1
+    assert fl["controller"]["counters"]["fenced"] == 0
+
+
+def test_sim_autoscale_fakeredis():
+    """Same control loop through the real RedisBroker code paths
+    (epoch INCR fencing included) on the virtual-clock FakeRedis."""
+    r = run_twice(autoscale_spec(broker_kind="fakeredis", requests=200))
+    assert r["invariants"]["violations"] == 0
+    assert r["fleet"]["spawns"] > 0
+    assert r["fleet"]["peak_alive"] > 1
+
+
+def test_sim_controller_crash_zombie_fenced():
+    """Crash the controller mid-surge, restart it 2s later, and leave
+    the dead instance ticking as a zombie: the fresh epoch reconciles
+    from the registry (zero duplicate spawns — checker-certified) while
+    every actuation the zombie plans dies at the broker fence."""
+    spec = autoscale_spec(seed=9)
+    spec["faults"] = [
+        {"kind": "controller_crash", "at_s": 25.0,
+         "restart_after_s": 2.0, "zombie": True},
+    ]
+    r = run_twice(spec)
+    assert r["invariants"]["violations"] == 0
+    assert r["faults"]["controller_crashes"] == 1
+    assert r["faults"]["controller_restarts"] == 1
+    fl = r["fleet"]
+    assert fl["zombie_fenced"] > 0       # the zombie kept planning
+    assert fl["controller"]["counters"]["fenced"] == 0  # the live one never
+    assert fl["spawns"] > 0
+
+
+# -- chaos: flapping registration ---------------------------------------------
+
+
+@pytest.mark.parametrize("kind", BROKER_KINDS)
+def test_chaos_flap_registration_storm(kind):
+    """tools/chaos_serve.py --fault flap: a worker registering and
+    deregistering every few ms must never be routed to mid-gap, never
+    draw a controller actuation, and exactly-one-terminal must hold."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "chaos_serve.py"),
+         "--fault", "flap", "--requests", "24", "--broker", kind],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["violation"] is None
+    assert report["routed_mid_gap"] == 0
+    assert report["controller_actions"] == 0
+    assert report["ok"] == report["requests"]
+    assert report["flaps"] >= 3

@@ -599,7 +599,9 @@ def test_producer_brownout_sheds_batch_then_standard_never_interactive():
         assert status == 429
         assert "brownout" in body["error"]
         assert body["brownout_state"] == "shed-batch"
-        assert headers.get("Retry-After") == "2"
+        # Dwell-derived: the ladder cannot de-escalate sooner than its
+        # dwell, so that is the honest earliest-retry hint.
+        assert headers.get("Retry-After") == "5"
         assert b.queue_depth() == 0  # shed before queueing
 
         _answered(b)

@@ -511,6 +511,190 @@ def run_burst(args):
     return 1 if violations else 0
 
 
+def run_flap(args):
+    """Registry flap chaos (``--fault flap``).
+
+    A phantom worker rapidly registers and deregisters (period ~10ms)
+    while one stable replica serves a steady stream through the Router.
+    Three contracts under audit:
+
+    - requests the Router placed on the flapper during an up-window are
+      evacuated by the failover sweep (orphan routed queue) and still
+      answered exactly once with the exact scripted payload;
+    - once the flapper is durably gone, the Router never again places
+      work on it — no routing into the gap;
+    - a reconciling FleetController watching the same registry holds
+      still: registry flapping alone, with neutral telemetry, must not
+      produce a single spawn or retire (dwell + telemetry-driven
+      planning absorb membership noise).
+    """
+    from llmss_tpu.serve.controller import FleetController
+    from llmss_tpu.serve.fleet import Router
+
+    args.workers = 1
+    prod_broker, (wb,) = build_brokers(args)
+
+    host = ChaosWorkerHost(
+        lambda: Worker(
+            ScriptedEngine(), wb, batch_size=args.batch_size,
+            poll_timeout_s=0.02, pad_batch=False,
+        ),
+        respawn_delay_s=0.02,
+    )
+    host.start()
+
+    router = Router(
+        prod_broker, policy="least_loaded", failover_check_s=0.05,
+    )
+
+    flap_id = "flap-w"
+    stop_flap = threading.Event()
+    flap_lock = threading.Lock()
+    flap_state = {"registered": False, "since": time.monotonic(), "ups": 0}
+
+    def flapper():
+        while not stop_flap.is_set():
+            prod_broker.register_worker({
+                "worker_id": flap_id, "model": "scripted",
+                "role": "unified", "heartbeat_ts": time.time(),
+                "heartbeat_s": 0.5, "free_slots": 8,
+            })
+            with flap_lock:
+                flap_state["registered"] = True
+                flap_state["since"] = time.monotonic()
+                flap_state["ups"] += 1
+            time.sleep(0.005)
+            prod_broker.deregister_worker(flap_id)
+            with flap_lock:
+                flap_state["registered"] = False
+                flap_state["since"] = time.monotonic()
+            time.sleep(0.005)
+
+    flap_thread = threading.Thread(target=flapper, daemon=True)
+    flap_thread.start()
+
+    # A reconciling controller over the same (flapping) registry. Its
+    # telemetry is pinned neutral — any actuation it takes can only have
+    # come from membership noise, which is exactly the non-contract.
+    actions: list = []
+    ctrl = FleetController(
+        prod_broker,
+        spawn=lambda role: (
+            actions.append(("spawn", role)), f"flap-spawn-{len(actions)}",
+        )[1],
+        retire=lambda wid: actions.append(("retire", wid)),
+        read_telemetry=lambda: {
+            "ts": time.monotonic(), "burn": 1.0,
+            "queue_depth": 0, "handoff_depth": 0, "util": {},
+        },
+        roles=("unified",), floor=1, ceiling=4,
+        check_s=0.02, cooldown_s=0.1, dwell_s=0.5,
+    )
+    ctrl.start()
+    stop_ctrl = threading.Event()
+
+    def ctrl_loop():
+        while not stop_ctrl.is_set():
+            ctrl.tick()
+            time.sleep(0.01)
+
+    ctrl_thread = threading.Thread(target=ctrl_loop, daemon=True)
+    ctrl_thread.start()
+
+    mid_gap: list[str] = []
+
+    def routed_mid_gap() -> bool:
+        # Only a route placed while the flapper has been CONTINUOUSLY
+        # deregistered for longer than any registry-read race window
+        # counts — flap period is ~10ms, so 250ms of gap is unambiguous.
+        with flap_lock:
+            return (
+                not flap_state["registered"]
+                and time.monotonic() - flap_state["since"] > 0.25
+            )
+
+    reqs = [
+        GenerateRequest(
+            token_ids=[i % 1000 + 1], max_new_tokens=4,
+            slo_class=SLO_CLASSES[i % len(SLO_CLASSES)],
+            deadline_ts=time.time() + args.deadline_s,
+        )
+        for i in range(args.requests)
+    ]
+    routed_to_flapper = 0
+    for r in reqs:
+        wid = router.submit(r)
+        if wid == flap_id:
+            routed_to_flapper += 1
+            if routed_mid_gap():
+                mid_gap.append(r.id)
+        time.sleep(0.003)
+
+    # Durably kill the flapper, then probe: nothing may route there now.
+    stop_flap.set()
+    flap_thread.join(timeout=2.0)
+    prod_broker.deregister_worker(flap_id)
+    with flap_lock:
+        flap_state["registered"] = False
+        flap_state["since"] = time.monotonic() - 1.0
+    probes = [
+        GenerateRequest(
+            token_ids=[500 + i], max_new_tokens=4,
+            deadline_ts=time.time() + args.deadline_s,
+        )
+        for i in range(10)
+    ]
+    for r in probes:
+        wid = router.submit(r)
+        if wid == flap_id:
+            mid_gap.append(r.id)
+
+    # Evacuate anything still parked on the flapper's orphan queue.
+    deadline = time.time() + args.deadline_s
+    while time.time() < deadline:
+        router.check_failover(force=True)
+        if not prod_broker.routed_depths().get(flap_id):
+            break
+        time.sleep(0.05)
+
+    everything = reqs + probes
+    results = collect_responses(
+        prod_broker, everything, timeout_s=args.deadline_s,
+    )
+    stop_ctrl.set()
+    ctrl_thread.join(timeout=2.0)
+    host.stop()
+
+    violation = None
+    successes = 0
+    try:
+        successes = audit_exactly_once(
+            everything, results, broker=prod_broker,
+        )
+    except AssertionError as e:
+        violation = str(e)
+
+    report = {
+        "fault": "flap",
+        "requests": len(everything),
+        "ok": successes,
+        "flaps": flap_state["ups"],
+        "routed_to_flapper": routed_to_flapper,
+        "routed_mid_gap": len(mid_gap),
+        "failover_reroutes": router.stats()["failover_reroutes"],
+        "controller_actions": len(actions),
+        "controller_counters": ctrl.counters,
+        "dlq_depth": prod_broker.dlq_depth(),
+        "delivery": prod_broker.delivery_stats(),
+        "host_error": host.error,
+        "violation": violation,
+    }
+    print(json.dumps(report))
+    violations = bool(violation or host.error or mid_gap or actions)
+    violations |= flap_state["ups"] < 3  # the storm must actually flap
+    return 1 if violations else 0
+
+
 def run_scenario(args):
     """Replay a fleet-simulator scenario's fault plane against a REAL
     in-process fleet (``--scenario file.json``).
@@ -760,7 +944,7 @@ def main(argv=None):
     p.add_argument("--batch-size", type=int, default=1)
     p.add_argument("--fault",
                    choices=("drain", "hang", "nan", "kill-mid-handoff",
-                            "burst"),
+                            "burst", "flap"),
                    default=None,
                    help="run a deterministic scripted-failure scenario "
                         "instead of the random kill/drop fleet")
@@ -785,6 +969,8 @@ def main(argv=None):
         return run_kill_mid_handoff(args)
     if args.fault == "burst":
         return run_burst(args)
+    if args.fault == "flap":
+        return run_flap(args)
     if args.fault is not None:
         return run_fault(args)
 
