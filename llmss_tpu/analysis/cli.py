@@ -1,12 +1,21 @@
-"""graftlint CLI.
+"""graftlint / shardcheck CLI.
 
 Usage::
 
     python -m llmss_tpu.analysis PATH [PATH ...]
         [--baseline tools/lint_baseline.json] [--write-baseline] [--list-rules]
+    python -m llmss_tpu.analysis --shardcheck
+        [--manifest tools/comms_manifest.json] [--update-manifest]
+        [--mesh 1,1,2] [--only PREFIX[,PREFIX...]]
 
-Exit codes: 0 = clean (or everything baselined/suppressed), 1 = findings,
-2 = usage or parse error.
+The default mode is the AST lint (graftlint — no jax import, runs
+anywhere). ``--shardcheck`` instead traces and compiles every production
+jitted program over an audit mesh and checks the jaxpr/HLO for SPMD
+hazards plus collective-inventory drift against the committed golden
+manifest (``analysis/shardcheck.py``).
+
+Exit codes (both modes): 0 = clean (or everything baselined/suppressed),
+1 = findings, 2 = usage, parse, or audit-infrastructure error.
 """
 
 from __future__ import annotations
@@ -30,6 +39,8 @@ RULES = {
     "span-not-ended": "start_span() discarded or not ended on all paths",
     "unbounded-metric-label": "metric series name/label built from a "
     "per-request identifier",
+    "fetch-inside-jit-scan": "host fetch (device_get/np.asarray/.item()) "
+    "on a tracer inside a lax.scan/fori_loop/while_loop body",
     "unguarded-write": "write to a `# guarded_by:` attr outside its lock",
     "lock-order-cycle": "cycle in the lock-acquisition-order graph",
 }
@@ -134,13 +145,68 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
     )
+    parser.add_argument(
+        "--shardcheck", action="store_true",
+        help="run the IR-level SPMD audit (traces + compiles the "
+        "production programs; needs jax) instead of the AST lint",
+    )
+    parser.add_argument(
+        "--manifest", default="tools/comms_manifest.json",
+        help="golden collective-traffic manifest for --shardcheck "
+        "(default: %(default)s)",
+    )
+    parser.add_argument(
+        "--update-manifest", action="store_true",
+        help="regenerate the comms manifest from the current audit "
+        "instead of diffing against it",
+    )
+    parser.add_argument(
+        "--mesh", default="1,1,2", metavar="DP,SP,TP",
+        help="audit mesh for --shardcheck (default: %(default)s)",
+    )
+    parser.add_argument(
+        "--only", default=None, metavar="PREFIX[,PREFIX...]",
+        help="restrict --shardcheck to programs whose signature starts "
+        "with one of the prefixes (skips the full-registry manifest diff "
+        "directions)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        width = max(len(r) for r in RULES)
+        from .shardcheck_rules import SHARD_RULES
+
+        catalog = {**RULES, **SHARD_RULES}
+        width = max(len(r) for r in catalog)
         for rule, desc in RULES.items():
             print(f"{rule:<{width}}  {desc}")
+        print()
+        for rule, desc in SHARD_RULES.items():
+            print(f"{rule:<{width}}  {desc}  [--shardcheck]")
         return 0
+
+    if args.shardcheck:
+        # Imported lazily: this pulls in jax (and initializes the
+        # backend), which the AST-only path must never do.
+        from .shardcheck import DEFAULT_BASELINE, run_shardcheck
+
+        try:
+            dp, sp, tp = (int(x) for x in args.mesh.split(","))
+        except ValueError:
+            print(f"bad --mesh {args.mesh!r} (want DP,SP,TP)", file=sys.stderr)
+            return 2
+        from llmss_tpu.parallel.mesh import MeshPlan
+
+        baseline = args.baseline
+        if baseline == parser.get_default("baseline"):
+            baseline = DEFAULT_BASELINE  # shardcheck keeps its own file
+        code, _ = run_shardcheck(
+            args.manifest,
+            update_manifest=args.update_manifest,
+            baseline_path=None if args.no_baseline else baseline,
+            plan=MeshPlan(dp=dp, sp=sp, tp=tp),
+            only=args.only.split(",") if args.only else None,
+        )
+        return code
 
     if not args.paths:
         parser.print_usage(sys.stderr)

@@ -36,6 +36,13 @@ Rules emitted here:
                            per-request identifier (``req_id`` etc.) — every
                            request mints a new series and the registry grows
                            without bound
+``fetch-inside-jit-scan``  host fetch (``jax.device_get``/``np.asarray``/
+                           ``.item()``…) on a traced value inside a
+                           ``lax.scan``/``fori_loop``/``while_loop`` body —
+                           unlike ``jit-host-sync`` this resolves the body
+                           function from the loop *call site*, so it also
+                           covers bodies defined at module scope (never
+                           lexically inside a jitted def) and lambdas
 """
 
 from __future__ import annotations
@@ -59,6 +66,11 @@ _STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding", "name"}
 #: ``_check_wall_clock``). A wall-clock read anywhere else is a bug.
 _WALL_EXEMPT = frozenset({"deadline_ts", "wall_anchor"})
 
+#: lax loop constructs whose body callables run traced on every iteration:
+#: maps the construct name to the positional indices of its traced
+#: body/cond function arguments (``while_loop`` traces both).
+_LAX_LOOP_BODY_ARGS = {"scan": (0,), "fori_loop": (2,), "while_loop": (0, 1)}
+
 #: Metric-registry lookups: the argument is a series *name* (or, for
 #: ``labels``, a label value) and must come from a bounded vocabulary.
 _METRIC_FUNCS = {"counter", "histogram", "labels"}
@@ -81,6 +93,10 @@ class Aliases:
     time_funcs: set[str]  # `from time import time [as t]`
     jit_names: set[str]   # `from jax import jit [as j]`
     partial_names: set[str]
+    lax: set[str] = dataclasses.field(default_factory=set)
+    #: `from jax.lax import scan [as s]`: bound name -> loop kind
+    lax_funcs: dict[str, str] = dataclasses.field(default_factory=dict)
+    device_get_names: set[str] = dataclasses.field(default_factory=set)
 
 
 def collect_aliases(tree: ast.Module) -> Aliases:
@@ -95,6 +111,8 @@ def collect_aliases(tree: ast.Module) -> Aliases:
                     al.jax_numpy.add(name)
                 elif a.name == "jax":
                     al.jax.add(name)
+                elif a.name == "jax.lax":
+                    al.lax.add(name)
                 elif a.name == "time":
                     al.time_mods.add(name)
                 elif a.name == "functools":
@@ -108,10 +126,16 @@ def collect_aliases(tree: ast.Module) -> Aliases:
                     al.jit_names.add(name)
                 elif node.module == "jax" and a.name == "numpy":
                     al.jax_numpy.add(name)
+                elif node.module == "jax" and a.name == "lax":
+                    al.lax.add(name)
+                elif node.module == "jax" and a.name == "device_get":
+                    al.device_get_names.add(name)
                 elif node.module == "functools" and a.name == "partial":
                     al.partial_names.add(name)
                 elif node.module == "jax.numpy":
                     al.jax_numpy.add(name)
+                elif node.module == "jax.lax" and a.name in _LAX_LOOP_BODY_ARGS:
+                    al.lax_funcs[name] = a.name
     return al
 
 
@@ -479,6 +503,171 @@ def _seed_params(fn: ast.FunctionDef, site: JitSite) -> set[str]:
             seeds.discard(params[idx])
     seeds.discard("self")
     return seeds
+
+
+# --------------------------------------------------------------------------
+# fetch-inside-jit-scan: host fetches inside lax loop bodies
+# --------------------------------------------------------------------------
+
+def _lax_loop_kind(func: ast.expr, al: Aliases) -> str | None:
+    """``scan``/``fori_loop``/``while_loop`` if ``func`` is that lax
+    construct under any alias, else None."""
+    if isinstance(func, ast.Name):
+        return al.lax_funcs.get(func.id)
+    if isinstance(func, ast.Attribute) and func.attr in _LAX_LOOP_BODY_ARGS:
+        root = func.value
+        if isinstance(root, ast.Name) and root.id in al.lax:
+            return func.attr
+        if (
+            isinstance(root, ast.Attribute)
+            and root.attr == "lax"
+            and isinstance(root.value, ast.Name)
+            and root.value.id in al.jax
+        ):
+            return func.attr
+    return None
+
+
+class _ScanBodyChecker(_TaintVisitor):
+    """Flags host fetches on traced values inside a lax loop body.
+
+    ``jit-host-sync`` only sees bodies lexically nested inside a
+    registered jitted def; loop bodies are frequently module-level
+    functions handed to ``lax.scan`` (or lambdas), which that pass never
+    enters. Here the body is resolved from the loop *call site*, its
+    parameters are seeded as tracers, and any fetch — ``jax.device_get``,
+    ``np.asarray``, ``.item()``, ``float()`` … — is a finding: under
+    tracing the fetch cannot happen per-iteration at all (it escapes the
+    trace or crashes), so the value must be returned from the loop and
+    fetched once on the host.
+    """
+
+    def __init__(self, al: Aliases, seeds: set[str], path: str, kind: str):
+        super().__init__(al, seeds)
+        self.path = path
+        self.kind = kind
+        self.findings: list[Finding] = []
+
+    def _flag(self, node: ast.AST, what: str, expr: str) -> None:
+        self.findings.append(Finding(
+            "fetch-inside-jit-scan", self.path, node.lineno, node.col_offset,
+            f"{what} on traced value `{expr}` inside a lax.{self.kind} "
+            "body — a per-iteration fetch cannot run under tracing; return "
+            "the value from the loop and fetch it once on the host",
+        ))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            root = func.value
+            if (
+                func.attr == "device_get"
+                and isinstance(root, ast.Name)
+                and root.id in self.al.jax
+                and node.args
+                and self.expr_tainted(node.args[0])
+            ):
+                self._flag(
+                    node, f"{root.id}.device_get()", _unparse(node.args[0])
+                )
+            elif (
+                isinstance(root, ast.Name)
+                and root.id in self.al.numpy
+                and func.attr in _NP_SYNC_FUNCS
+                and node.args
+                and self.expr_tainted(node.args[0])
+            ):
+                self._flag(
+                    node, f"{root.id}.{func.attr}()", _unparse(node.args[0])
+                )
+            elif func.attr in _SYNC_METHODS and self.expr_tainted(root):
+                self._flag(node, f"`.{func.attr}()`", _unparse(root))
+        elif isinstance(func, ast.Name):
+            if func.id in self.al.device_get_names and node.args and (
+                self.expr_tainted(node.args[0])
+            ):
+                self._flag(node, "device_get()", _unparse(node.args[0]))
+            elif func.id in _SYNC_BUILTINS and node.args and (
+                self.expr_tainted(node.args[0])
+            ):
+                self._flag(node, f"{func.id}()", _unparse(node.args[0]))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        inner = _ScanBodyChecker(
+            self.al,
+            self.tainted | {a.arg for a in node.args.args},
+            self.path,
+            self.kind,
+        )
+        for stmt in node.body:
+            inner.visit(stmt)
+        self.findings.extend(inner.findings)
+
+
+def _check_loop_body(
+    path: str,
+    al: Aliases,
+    reg: JitRegistry,
+    kind: str,
+    body: ast.expr,
+    seen: set[tuple[str, int]],
+) -> list[Finding]:
+    """Resolve one loop-body argument expression and check it."""
+    bound_pos, bound_kw = 0, set()
+    if isinstance(body, ast.Call) and _is_partial(body.func, al):
+        bound_pos = max(len(body.args) - 1, 0)
+        bound_kw = {kw.arg for kw in body.keywords if kw.arg}
+        body = body.args[0] if body.args else None
+
+    if isinstance(body, ast.Lambda):
+        seeds = {a.arg for a in body.args.args[bound_pos:]} - bound_kw
+        checker = _ScanBodyChecker(al, seeds, path, kind)
+        checker.visit(body.body)
+        return checker.findings
+
+    if isinstance(body, ast.Name):
+        name = body.id
+    elif isinstance(body, ast.Attribute):
+        name = body.attr
+    else:
+        return []
+    entry = reg.functions.get(name)
+    if entry is None:
+        return []
+    fn, fn_path = entry
+    # Only analyse bodies defined in the module being checked: findings
+    # anchor at the body's own source, and cross-module dedup happens by
+    # each module checking (exactly) its own defs.
+    if fn_path != path or (name, fn.lineno) in seen:
+        return []
+    seen.add((name, fn.lineno))
+    params = [a.arg for a in fn.args.args]
+    seeds = set(params[bound_pos:]) - bound_kw
+    seeds.discard("self")
+    checker = _ScanBodyChecker(al, seeds, path, kind)
+    for stmt in fn.body:
+        checker.visit(stmt)
+    return checker.findings
+
+
+def _check_scan_sites(
+    path: str, tree: ast.Module, al: Aliases, reg: JitRegistry
+) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[tuple[str, int]] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _lax_loop_kind(node.func, al)
+        if kind is None:
+            continue
+        for idx in _LAX_LOOP_BODY_ARGS[kind]:
+            if idx < len(node.args):
+                findings.extend(
+                    _check_loop_body(path, al, reg, kind, node.args[idx], seen)
+                )
+    return findings
 
 
 # --------------------------------------------------------------------------
@@ -854,6 +1043,8 @@ def check_module(
     metric_checker = _MetricLabelChecker(path)
     metric_checker.visit(tree)
     findings.extend(metric_checker.findings)
+
+    findings.extend(_check_scan_sites(path, tree, al, reg))
 
     # analyse jitted function bodies defined in this module
     seen: set[tuple[str, int]] = set()

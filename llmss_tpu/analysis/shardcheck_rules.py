@@ -1,0 +1,22 @@
+"""shardcheck rule catalog, importable without jax.
+
+``shardcheck.py`` imports jax at module load (it traces and compiles real
+programs); the CLI's ``--list-rules`` and the docs tests only need the
+names and one-liners, so the catalog lives here where the AST-only path
+can read it.
+"""
+
+from __future__ import annotations
+
+SHARD_RULES = {
+    "partial-sum-leak": "scan-stacked ys reach a host-fetched output "
+    "without a replicated sharding pin (unreduced over tp)",
+    "donation-unmatched": "donated input has no aliasable output "
+    "(same shape/dtype) — the donation buys nothing",
+    "donation-dropped": "compiled executable aliases fewer buffers than "
+    "declared donations (or XLA warned the donation was unusable)",
+    "host-fetch-not-replicated": "a host-fetched output compiles to a "
+    "non-replicated sharding (every fetch gathers shards)",
+    "comms-manifest-drift": "per-program collective counts/bytes differ "
+    "from the golden tools/comms_manifest.json",
+}

@@ -440,6 +440,8 @@ class DecodeEngine:
         A poisoned row is forced done on device — its later "tokens" are
         EOS fills — and the host errors out exactly that row; co-batched
         rows never see it (row isolation is positional)."""
+        from llmss_tpu.parallel.sharding import ys_pin
+
         body = partial(
             DecodeEngine._decode_step_body, cfg, mesh, params, sample_args,
             eos, t_bucket,
@@ -450,7 +452,12 @@ class DecodeEngine:
             length=n_steps,
         )
         tokens, cache, cur_pos, done, poisoned = carry
-        return toks.T, cache, cur_pos, done, poisoned  # toks [B, n_steps]
+        # The host reads the stacked tokens: pin them replicated, same
+        # GSPMD partial-sum hazard as _decode_group_impl (found by
+        # shardcheck — this path predates the grouped fix and leaked the
+        # same unpinned ys to np.asarray in generate_fused/speculative).
+        pin = ys_pin(mesh)
+        return pin(toks.T), cache, cur_pos, done, poisoned  # [B, n_steps]
 
     @staticmethod
     def _decode_step_body(cfg, mesh, params, sample_args, eos, t_bucket,
@@ -514,17 +521,12 @@ class DecodeEngine:
         # the host reads token values summed over the tp axis (observed:
         # every packed token exactly tp× its true value). The carry never
         # hits this — its sharding is pinned by the next iteration's
-        # consumers — only the ys leave the loop unconstrained.
-        from jax.sharding import NamedSharding, PartitionSpec
+        # consumers — only the ys leave the loop unconstrained
+        # (parallel/sharding.ys_pin documents the hazard; shardcheck's
+        # partial-sum-leak rule gates it).
+        from llmss_tpu.parallel.sharding import ys_pin
 
-        rep = (
-            NamedSharding(mesh, PartitionSpec()) if mesh is not None
-            else None
-        )
-        pin = (
-            (lambda x: jax.lax.with_sharding_constraint(x, rep))
-            if rep is not None else (lambda x: x)
-        )
+        pin = ys_pin(mesh)
 
         def chunk(carry, _):
             carry, toks = jax.lax.scan(body, carry, None, length=n_steps)
@@ -610,17 +612,10 @@ class DecodeEngine:
             eos,
         )
         # Pin the stacked ys replicated — same GSPMD partial-sum hazard
-        # as _decode_group_impl.
-        from jax.sharding import NamedSharding, PartitionSpec
+        # as _decode_group_impl (parallel/sharding.ys_pin).
+        from llmss_tpu.parallel.sharding import ys_pin
 
-        rep = (
-            NamedSharding(mesh, PartitionSpec()) if mesh is not None
-            else None
-        )
-        pin = (
-            (lambda x: jax.lax.with_sharding_constraint(x, rep))
-            if rep is not None else (lambda x: x)
-        )
+        pin = ys_pin(mesh)
 
         def step(carry, xs):
             carry, tok = body(carry, xs)
@@ -749,7 +744,9 @@ class DecodeEngine:
         ``lower_thunk().cost_analysis()`` (prewarm passes the thunk), else
         the analytical model. ``key`` must be identical between the
         prewarm derivation and the fold-site lookup."""
-        full_key = (kind, *key)
+        from llmss_tpu.utils.signatures import signature
+
+        full_key = signature(kind, *key)
         hit = devtel.costs().get(full_key)
         if hit is not None:
             # The per-dispatch path: never price the analytical model on

@@ -227,14 +227,11 @@ def spec_group_impl(
     # logits into the scan's stacked outputs, and the host reads choices
     # summed over the tp axis (tp× their true value — the same hazard
     # fixed in DecodeEngine._decode_group_impl). The carry is immune;
-    # only the ys leave the loop unconstrained.
-    from jax.sharding import NamedSharding, PartitionSpec
+    # only the ys leave the loop unconstrained (parallel/sharding.ys_pin
+    # documents the hazard; shardcheck's partial-sum-leak rule gates it).
+    from llmss_tpu.parallel.sharding import ys_pin
 
-    rep = NamedSharding(mesh, PartitionSpec()) if mesh is not None else None
-    pin = (
-        (lambda x: jax.lax.with_sharding_constraint(x, rep))
-        if rep is not None else (lambda x: x)
-    )
+    pin = ys_pin(mesh)
 
     def body(carry, _):
         hist, hist_len, cache, done = carry

@@ -35,6 +35,32 @@ _NEG_INF = float(jnp.finfo(jnp.float32).min)
 IMPL_OVERRIDE: str | None = os.environ.get("LLMSS_ATTN_IMPL") or None
 
 
+class force_impl:
+    """Scoped IMPL_OVERRIDE: ``with force_impl("xla"): ...`` traces every
+    program inside the block with one pinned attention implementation and
+    restores the previous override on exit. shardcheck audits lowered HLO
+    under this pin — the collective inventory in tools/comms_manifest.json
+    is only golden against ONE deterministic lowering, and an ambient
+    LLMSS_ATTN_IMPL=pallas would silently diff every program. Also the
+    right tool for A/B benches that previously mutated the global by hand.
+    """
+
+    def __init__(self, impl: str | None):
+        self.impl = impl
+        self._saved: str | None = None
+
+    def __enter__(self):
+        global IMPL_OVERRIDE
+        self._saved = IMPL_OVERRIDE
+        IMPL_OVERRIDE = self.impl
+        return self
+
+    def __exit__(self, *exc):
+        global IMPL_OVERRIDE
+        IMPL_OVERRIDE = self._saved
+        return False
+
+
 def tp_head_plan(Hq: int, Hkv: int, tp: int) -> tuple[bool, bool, str | None]:
     """Shared TP-shardability rule for attention heads: returns
     ``(kv_shard, heads_ok, kv_axis)``.

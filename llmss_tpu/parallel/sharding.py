@@ -48,3 +48,27 @@ def constrain(x, spec: P):
         return jax.lax.with_sharding_constraint(x, spec)
     except (ValueError, RuntimeError):
         return x
+
+
+def replicated(mesh: Mesh | None) -> NamedSharding | None:
+    """Fully-replicated NamedSharding on ``mesh`` (None when no mesh)."""
+    return NamedSharding(mesh, P()) if mesh is not None else None
+
+
+def ys_pin(mesh: Mesh | None):
+    """The replicated pin for scan-stacked ``ys`` that leave a jitted
+    program for the host.
+
+    GSPMD otherwise propagates an unreduced partial-sum layout from
+    tp-sharded logits into the scan's stacked outputs, and the host reads
+    values summed over the tp axis (observed in the grouped decode path:
+    every packed token exactly tp× its true value). Carries are immune —
+    their sharding is pinned by the next iteration's consumers — only the
+    ys leave the loop unconstrained, so every scan whose ys are
+    host-fetched must wrap them with this pin (shardcheck's
+    ``partial-sum-leak`` rule enforces exactly that discipline).
+    """
+    rep = replicated(mesh)
+    if rep is None:
+        return lambda x: x
+    return lambda x: jax.lax.with_sharding_constraint(x, rep)

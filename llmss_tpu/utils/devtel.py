@@ -56,11 +56,15 @@ from collections import deque
 
 from llmss_tpu.utils import metrics as metrics_mod
 from llmss_tpu.utils import trace
+from llmss_tpu.utils.signatures import METERED_CLASSES, signature_str
 
 # Closed kernel-class enum: every MFU/MBU series name is ``mfu_<class>``/
 # ``mbu_<class>`` with <class> drawn from here, so the graftlint
-# unbounded-metric-label rule holds by construction.
-KERNEL_CLASSES = ("prefill", "decode", "decode_group", "ragged_group")
+# unbounded-metric-label rule holds by construction. Shared with the
+# shardcheck program registry via utils/signatures.py — one vocabulary
+# for both planes, so a class added to one cannot silently miss the
+# other.
+KERNEL_CLASSES = METERED_CLASSES
 
 # Utilization histogram bounds (MFU/MBU are fractions in [0, 1]).
 UTIL_BOUNDS = (
@@ -222,7 +226,7 @@ class CostTable:
     def export(self) -> dict:
         with self._lock:
             return {
-                "/".join(str(p) for p in key): c.to_dict()
+                signature_str(key): c.to_dict()
                 for key, c in self._costs.items()
             }
 

@@ -1,0 +1,56 @@
+"""Executable-signature vocabulary shared by devtel and shardcheck.
+
+One closed enum of kernel classes and ONE formatting convention for
+executable signatures, so the runtime cost plane (``utils/devtel.py``'s
+``CostTable``) and the static SPMD auditor (``analysis/shardcheck.py``'s
+program registry and ``tools/comms_manifest.json``) can never drift: a
+signature priced at dispatch time and a signature audited at lint time
+render to the same ``kind/part/part`` string.
+
+Pure stdlib on purpose — devtel imports this with tracing off and the
+AST-only lint CI job imports nothing heavier than this module.
+"""
+
+from __future__ import annotations
+
+#: Every executable class either plane may key by. The first four are the
+#: model-forward classes devtel meters (MFU/MBU series names are
+#: ``mfu_<class>``/``mbu_<class>``); the rest are the state-management
+#: programs shardcheck audits (scatters and merges — roofline-metering
+#: them would be noise, but their sharding/donation/collective contracts
+#: are load-bearing).
+KERNEL_CLASSES = (
+    "prefill",
+    "decode",
+    "decode_many",
+    "decode_group",
+    "ragged_group",
+    "spec_group",
+    "admit_merge",
+    "seed",
+    "import_blocks",
+)
+
+#: The subset devtel prices and exports MFU/MBU series for.
+METERED_CLASSES = ("prefill", "decode", "decode_group", "ragged_group")
+
+
+def signature(kind: str, *key) -> tuple:
+    """The canonical executable signature: ``(kind, *shape-key parts)``.
+
+    ``kind`` must come from :data:`KERNEL_CLASSES` — an unknown class is a
+    programming error at the call site (a new executable family must be
+    added to the enum, where both planes see it), not a new dict key.
+    """
+    if kind not in KERNEL_CLASSES:
+        raise ValueError(
+            f"unknown kernel class {kind!r}; add it to "
+            f"signatures.KERNEL_CLASSES (have: {', '.join(KERNEL_CLASSES)})"
+        )
+    return (kind, *key)
+
+
+def signature_str(sig: tuple) -> str:
+    """Render a signature for export keys and manifest program names:
+    ``/``-joined parts (``decode_group/8/4/16/None``)."""
+    return "/".join(str(p) for p in sig)

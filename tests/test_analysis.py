@@ -96,6 +96,18 @@ from llmss_tpu.utils import metrics
 def handle(req_id):
     metrics.series().counter(f"requests_{req_id}").add()
 """,
+    "fetch-inside-jit-scan": """
+import jax
+import numpy as np
+
+def _step(carry, x):
+    y = carry + x
+    np.asarray(y)
+    return y, y
+
+def roll(init, xs):
+    return jax.lax.scan(_step, init, xs)
+""",
     "unguarded-write": """
 import threading
 
@@ -143,6 +155,14 @@ def test_each_violation_fixture_fails(tmp_path, rule):
 
 def test_fixture_catalog_covers_every_rule():
     assert set(VIOLATIONS) == set(RULES)
+
+
+def test_docs_catalog_covers_every_rule():
+    from llmss_tpu.analysis.shardcheck_rules import SHARD_RULES
+
+    doc = (REPO_ROOT / "docs" / "static-analysis.md").read_text()
+    for rule in [*RULES, *SHARD_RULES]:
+        assert f"`{rule}`" in doc, f"{rule} missing from docs/static-analysis.md"
 
 
 def test_clean_file_exits_zero(tmp_path):
@@ -351,6 +371,83 @@ def observe(req_id, phase, dur_s):
     metrics.series().counter("requests_total").add()
     metrics.series().histogram(f"{phase}_s").observe(dur_s)
     metrics.series().counter("reqs").labels(phase).add()
+""")
+    assert (code, findings) == (0, [])
+
+
+def test_fetch_in_scan_device_get_and_fori_body(tmp_path):
+    # device_get is the fetch jit-host-sync never modelled; the fori_loop
+    # body index (arg 2) and the `from jax import lax` alias must both
+    # resolve.
+    code, findings = lint(tmp_path, """
+import jax
+from jax import lax
+
+def _body(i, val):
+    jax.device_get(val)
+    return val + i
+
+def run(n, v0):
+    return lax.fori_loop(0, n, _body, v0)
+""")
+    assert code == 1
+    hits = [f for f in findings if f.rule == "fetch-inside-jit-scan"]
+    assert len(hits) == 1 and hits[0].line == 6
+    assert "fori_loop" in hits[0].message
+
+
+def test_fetch_in_while_loop_cond_and_lambda_body(tmp_path):
+    # while_loop traces BOTH callables; lambdas never appear in the jit
+    # registry, so the call-site resolution is the only way in.
+    code, findings = lint(tmp_path, """
+import jax
+
+def _cond(state):
+    return state.item() > 0
+
+def run(s0):
+    return jax.lax.while_loop(_cond, lambda s: float(s) + s, s0)
+""")
+    assert code == 1
+    hits = [f for f in findings if f.rule == "fetch-inside-jit-scan"]
+    assert {f.line for f in hits} == {5, 8}
+
+
+def test_fetch_in_scan_partial_bound_args_are_static(tmp_path):
+    # partial-bound leading params are trace-time constants (same contract
+    # as _seed_params for jit): fetching THEM is legal, fetching the scan
+    # carry is not.
+    code, findings = lint(tmp_path, """
+from functools import partial
+import jax
+import numpy as np
+
+def _step(cfg, table, carry, x):
+    np.asarray(table)
+    return carry + x, np.asarray(carry)
+
+def roll(cfg, table, init, xs):
+    return jax.lax.scan(partial(_step, cfg, table), init, xs)
+""")
+    assert code == 1
+    hits = [f for f in findings if f.rule == "fetch-inside-jit-scan"]
+    assert len(hits) == 1 and hits[0].line == 8
+
+
+def test_clean_scan_body_and_host_fetch_after_loop_not_flagged(tmp_path):
+    # Static attribute reads inside the body and the blessed shape — fetch
+    # the stacked ys ONCE after the loop returns — must stay quiet.
+    code, findings = lint(tmp_path, """
+import jax
+import numpy as np
+
+def _step(carry, x):
+    b = x.shape[0]
+    return carry + x, carry
+
+def roll(init, xs):
+    carry, ys = jax.lax.scan(_step, init, xs)
+    return np.asarray(ys)
 """)
     assert (code, findings) == (0, [])
 

@@ -1,12 +1,45 @@
 #!/usr/bin/env python
-"""Thin wrapper so `./tools/lint.py llmss_tpu` works from the repo root."""
+"""Run the full static-analysis gate from the repo root.
+
+With plain path arguments this runs BOTH passes CI gates on — the AST
+lint (graftlint) over the given paths, then the IR-level SPMD audit
+(shardcheck, which traces + compiles the production programs and diffs
+the collective inventory against tools/comms_manifest.json) — and exits
+with the worst code.
+
+    ./tools/lint.py llmss_tpu             # both passes
+    ./tools/lint.py --ast llmss_tpu       # AST pass only
+    ./tools/lint.py --shardcheck ...      # IR pass only (pass-through)
+
+Any invocation carrying an explicit mode flag (--shardcheck,
+--list-rules, --write-baseline) is passed straight through to
+``python -m llmss_tpu.analysis`` unchanged.
+"""
 
 import sys
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
 
 from llmss_tpu.analysis.cli import main  # noqa: E402
 
+
+def run(argv: list[str]) -> int:
+    if any(
+        f in argv for f in ("--shardcheck", "--list-rules", "--write-baseline")
+    ):
+        return main(argv)
+    if "--ast" in argv:
+        return main([a for a in argv if a != "--ast"])
+    ast_code = main(argv)
+    shard_code = main([
+        "--shardcheck",
+        "--manifest", str(ROOT / "tools" / "comms_manifest.json"),
+        "--baseline", str(ROOT / "tools" / "shardcheck_baseline.json"),
+    ])
+    return max(ast_code, shard_code)
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run(sys.argv[1:]))
