@@ -417,6 +417,38 @@ def export_blocks(
     }
 
 
+def export_dense_row(
+    cache: KVCache, row: int, n_tokens: int, block_size: int,
+) -> dict:
+    """Dense-ring analogue of ``export_blocks``: one row's first
+    ``n_tokens`` slots, reshaped into the same ``[L, nb, bs, ...]``
+    block layout (``nb = ceil(n_tokens/bs)``, tail zero-padded) so dense
+    and paged KV share ONE at-rest blob format (serve/kvstore.py).
+    Callers must not have ring-wrapped past ``n_tokens`` — slot ``i``
+    must still hold position ``i``'s KV (the scheduler's park guard
+    enforces this)."""
+    if not 0 < n_tokens <= cache.max_len:
+        raise ValueError(
+            f"n_tokens {n_tokens} outside (0, {cache.max_len}]"
+        )
+    nb = -(-n_tokens // block_size)
+    pad = nb * block_size - n_tokens
+
+    def grab(buf):
+        if buf is None:
+            return None
+        seg = np.asarray(jax.device_get(buf[:, row, :n_tokens]))
+        if pad:
+            widths = [(0, 0), (0, pad)] + [(0, 0)] * (seg.ndim - 2)
+            seg = np.pad(seg, widths)
+        return seg.reshape((seg.shape[0], nb, block_size) + seg.shape[2:])
+
+    return {
+        "k": grab(cache.k), "v": grab(cache.v),
+        "k_scale": grab(cache.k_scale), "v_scale": grab(cache.v_scale),
+    }
+
+
 def import_blocks(
     cache: PagedKVCache, k, v, k_scale, v_scale, block_ids,
 ) -> PagedKVCache:

@@ -54,8 +54,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from llmss_tpu.engine.cache import (
-    BlockAllocator, KVCache, PagedKVCache, export_blocks, import_blocks,
-    table_sentinel,
+    BlockAllocator, KVCache, PagedKVCache, export_blocks,
+    export_dense_row, import_blocks, table_sentinel,
 )
 from llmss_tpu.engine.engine import DecodeEngine, GenerationParams, _bucket
 from llmss_tpu.utils import devtel, trace
@@ -254,6 +254,20 @@ class ContinuousBatcher:
         # to the broker). None disables preemption entirely — the check
         # never runs, keeping FIFO deployments at zero overhead.
         self.preempt_cb: Callable[[str, list[int]], None] | None = None
+        # Tiered-KV hooks (serve/kvstore.py). ``demote_cb(prefix)``
+        # receives each idle Prefix evicted from the pool — its blocks
+        # are already freed (the Prefix owns its own arrays), so the
+        # store encodes off-thread while admission proceeds.
+        # ``park_cb(req_id, tokens, blocks)`` receives a finished session
+        # turn's exported KV (see ``_maybe_park``). Both None by default:
+        # without a store every eviction is a plain drop and no finish
+        # exports — bit-identical to the pre-tiering batcher.
+        self.demote_cb: Callable[..., None] | None = None
+        self.park_cb: Callable[..., None] | None = None
+        # req_id -> (token_ids, replayed): park interest registered by
+        # the serving layer, which is the only holder of prompt ids (the
+        # batcher's rows carry outputs, and adopted rows no ids at all).
+        self._park_ids: dict[str, tuple] = {}  # guarded_by: self._lock
         if self._paged:
             mb = engine.max_seq_len // engine.block_size
             n_blocks = engine.kv_blocks or rows * mb
@@ -424,6 +438,7 @@ class ContinuousBatcher:
         at the registry's own refcount of 1) — the paged admission's
         backstop when the pool runs dry. Returns sets evicted."""
         freed = 0
+        demoted = 0
         for key, (_pfx, blocks) in list(self._paged_prefixes.items()):
             if key == keep or not blocks:
                 continue
@@ -431,9 +446,19 @@ class ContinuousBatcher:
                 self.allocator.free(blocks)
                 del self._paged_prefixes[key]
                 freed += 1
+                if self.demote_cb is not None:
+                    # Tiered KV: hand the Prefix down instead of dropping
+                    # it. The blocks are already free — the store's encode
+                    # reads the Prefix's OWN arrays, off this thread.
+                    try:
+                        self.demote_cb(_pfx)
+                        demoted += 1
+                    except Exception:  # noqa: BLE001 — a failed demote is a drop
+                        pass
         if freed:
             self.allocator.record_evictions(freed)
-            self.engine.metrics.add_kv_evictions(freed)
+            self.engine.metrics.add_kv_evictions(demoted, demoted=True)
+            self.engine.metrics.add_kv_evictions(freed - demoted)
         return freed
 
     def _ensure_paged_prefix(self, prefix) -> list[int] | None:
@@ -1382,6 +1407,56 @@ class ContinuousBatcher:
             self._flush_stream(r)
         return True
 
+    def request_park(
+        self, req_id: str, token_ids, replayed: int = 0,
+    ) -> None:
+        """Register session-park interest for a request (thread-safe):
+        when its row finishes served, ``park_cb`` receives the full token
+        sequence (``token_ids`` + the non-replayed outputs) and the row's
+        exported KV blocks. Idempotent; a no-op without ``park_cb``."""
+        with self._lock:
+            self._park_ids[req_id] = (list(token_ids), int(replayed))
+
+    def forget_park(self, req_id: str) -> None:
+        """Withdraw park interest (submit/adopt failed after
+        registration — the row will never reach ``_finish``)."""
+        with self._lock:
+            self._park_ids.pop(req_id, None)
+
+    def _maybe_park(self, row: int, r: _Row, parked: tuple) -> None:
+        """Export the finished row's KV for session parking
+        (serve/kvstore.py). The device may still be running the in-flight
+        group, which keeps advancing this row past its last sampled token
+        — positions >= T-1 can be (re)written with garbage-continuation
+        KV after this host-side finish. Only positions < T-1 are
+        guaranteed stable, so the parked segment covers the first
+        (T-1)//bs FULL blocks; and when the in-flight lag could ring-wrap
+        into slot 0 (T-1 + group-lag past max_seq_len) parking is skipped
+        outright — the low slots themselves would be hazardous. Parking
+        is best-effort: any failure is a plain drop (the next turn
+        re-prefills), never an error on the finished request."""
+        ids, replayed = parked
+        seq = list(ids) + [int(t) for t in r.out[replayed:]]
+        T = len(seq)
+        eng = self.engine
+        bs = eng.block_size
+        if T - 1 + self.group_chunks * self.chunk_steps > eng.max_seq_len:
+            return
+        nf = (T - 1) // bs
+        if nf == 0:
+            return
+        try:
+            if self._paged:
+                blk = [int(b) for b in self._host_tables[row, :nf]]
+                if any(b >= self._sentinel for b in blk):
+                    return  # row shorter than its sequence claims
+                blocks = export_blocks(self.cache, blk, nf * bs)
+            else:
+                blocks = export_dense_row(self.cache, row, nf * bs, bs)
+            self.park_cb(r.req_id, seq[: nf * bs], blocks)
+        except Exception:  # noqa: BLE001 — parking never fails a request
+            pass
+
     def _finish(
         self, row: int, r: _Row, cancelled: bool = False,
         error: str | None = None,
@@ -1390,6 +1465,15 @@ class ContinuousBatcher:
         self._row_pos.pop(row, None)
         self._inflight_prefill.pop(row, None)
         self._prefill_plen.pop(row, None)
+        with self._lock:
+            parked = self._park_ids.pop(r.req_id, None)
+        if (
+            parked is not None and self.park_cb is not None
+            and error is None and not cancelled
+        ):
+            # Park BEFORE the release: the row's blocks must still be
+            # this row's when the export reads them.
+            self._maybe_park(row, r, parked)
         kv_block_s = self._paged_release_row(row)
         with self._lock:
             self._free.append(row)
@@ -1510,6 +1594,7 @@ class ContinuousBatcher:
         with self._lock:
             ids = [req_id for (req_id, *_rest) in self.pending]
             self.pending.clear()
+            self._park_ids.clear()
         self._inflight = None
         self._pending_adm = None
         self._last_fetch_t = None

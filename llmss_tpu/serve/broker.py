@@ -66,6 +66,10 @@ def _enqueue_attrs(req: GenerateRequest) -> dict:
     a["slo_class"] = req.slo_class
     if req.session_id:
         a["session"] = req.session_id
+        if req.turn is not None:
+            # Turn ordinal only means anything inside a session — the
+            # export keys think-time gaps and ordering off it.
+            a["turn"] = int(req.turn)
     return a
 
 

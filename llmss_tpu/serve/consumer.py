@@ -446,6 +446,7 @@ class ContinuousWorker:
         snapshot_interval_s: float = 1.0,
         role: str = "unified",
         chunked_prefill: int | None = None,
+        kvstore=None,
     ):
         from collections import deque
 
@@ -457,6 +458,11 @@ class ContinuousWorker:
         self.broker = broker
         self.tokenizer = tokenizer
         self.role = role
+        # Tiered KV store (serve/kvstore.py): None = pre-tiering behavior
+        # (evictions drop, sessions re-prefill). With a store: pool/LRU
+        # evictions DEMOTE, shared-prefix misses PROMOTE from T1/T2, and
+        # finished session turns PARK for zero-re-prefill resume.
+        self.kvstore = kvstore
         self.batcher = ContinuousBatcher(
             engine, rows=rows, chunk_steps=chunk_steps,
             chunk_steps_low=chunk_steps_low, group_chunks=group_chunks,
@@ -479,6 +485,12 @@ class ContinuousWorker:
             # live for one prefill, and a decode replica's requests arrive
             # as handoff records the request queue never redelivers.
             self.batcher.preempt_cb = self._on_preempt
+        if kvstore is not None:
+            self.batcher.demote_cb = self._on_demote
+            self.batcher.park_cb = self._on_park
+        # req_id -> session_id for requests whose finish should park
+        # (set before submit/adopt, popped by the park hook / done_cb).
+        self._park_sessions: dict[str, str] = {}
         # Decode role: popped-but-not-yet-adopted records (all rows busy).
         self._adopt_backlog: "deque" = deque()
         self.poll_timeout_s = poll_timeout_s
@@ -525,6 +537,13 @@ class ContinuousWorker:
             # committed to (their leases are ours) — routers should see it.
             "queue_depth": snap.get("pending", 0) + len(self._adopt_backlog),
             "prefix_hashes": sorted(hashes),
+            # Per-tier KV residency + lifecycle counters (numeric leaves
+            # only): the producer aggregates these fleet-wide and the
+            # Prometheus renderer walks them into families as-is.
+            **(
+                {"kv_tiers": self.kvstore.stats()}
+                if self.kvstore is not None else {}
+            ),
             "heartbeat_s": self.snapshot_interval_s,
             # Cross-process staleness stamp (see Worker.load_snapshot).
             "heartbeat_ts": _time.time(),  # lint: ignore[wall-clock-timer]
@@ -639,12 +658,32 @@ class ContinuousWorker:
                     self._get_prefix(req.prefix_token_ids)
                     if req.prefix_token_ids else None
                 )
+                if prefix is None and req.session_id and (
+                    self.kvstore is not None
+                ):
+                    # Session resume: a prior turn parked this session's
+                    # KV. If the parked tokens are a proper prefix of the
+                    # new turn's prompt, seed from them — the earlier
+                    # turns never re-prefill and the stream is
+                    # bit-identical to the never-evicted run.
+                    prefix = self._resume_session(req.session_id, ids)
                 if self.role == "prefill":
                     # Must be registered BEFORE submit: a short request
                     # can resolve (and its done_cb clean this up) inside
                     # the submit -> next step() window.
                     self._handoff_reqs[req.id] = req
                 self._reqs[req.id] = req
+                if req.session_id and self.kvstore is not None and (
+                    self.role != "prefill"
+                ):
+                    # Park interest BEFORE submit (a short request can
+                    # finish inside the submit -> step window). Prefill
+                    # role never parks: its rows end at export, and the
+                    # decode side owns the finished KV.
+                    self._park_sessions[req.id] = req.session_id
+                    self.batcher.request_park(
+                        req.id, ids, replayed=len(resume)
+                    )
                 self.batcher.submit(
                     ids, gen, cb, req_id=req.id, stream_cb=stream_cb,
                     prefix=prefix,
@@ -654,6 +693,8 @@ class ContinuousWorker:
             except ValueError as e:  # e.g. prompt + max_new exceeds the ring
                 self._handoff_reqs.pop(req.id, None)
                 self._reqs.pop(req.id, None)
+                self._park_sessions.pop(req.id, None)
+                self.batcher.forget_park(req.id)
                 self.broker.push_response(
                     GenerateResponse(id=req.id, error=str(e))
                 )
@@ -669,6 +710,9 @@ class ContinuousWorker:
         def cb(toks, cancelled=False, error=None):
             self._handoff_reqs.pop(req.id, None)
             self._reqs.pop(req.id, None)
+            # The park hook (which runs before this) already consumed the
+            # entry on the served path; this covers error/cancel paths.
+            self._park_sessions.pop(req.id, None)
             if error is not None:
                 # Row-level failure (e.g. poison containment): the
                 # batcher finished this row with an error; batch-mates
@@ -781,14 +825,26 @@ class ContinuousWorker:
         if req.stream:
             def stream_cb(new_toks, req=req):
                 self.broker.push_stream(req.id, new_toks)
+        if req.session_id and self.kvstore is not None:
+            # Adopted rows carry no prompt ids inside the batcher —
+            # register them here so the finish hook can park the session
+            # (withdrawn below if the adopt never takes a row).
+            self._park_sessions[req.id] = req.session_id
+            self.batcher.request_park(req.id, list(req.token_ids or []))
         try:
-            return self.batcher.adopt(
+            ok = self.batcher.adopt(
                 req.id, rec.first_token, rec.n_tokens, blocks, gen,
                 self._done_cb(req), stream_cb=stream_cb,
             )
         except Exception as e:  # noqa: BLE001 — e.g. block_size mismatch
+            self._park_sessions.pop(req.id, None)
+            self.batcher.forget_park(req.id)
             self.broker.fail_handoff(rec, error=str(e))
             return True
+        if not ok:
+            self._park_sessions.pop(req.id, None)
+            self.batcher.forget_park(req.id)
+        return ok
 
     def _drain_handoffs(self, backlog_only: bool = False) -> int:
         """Decode-role intake: adopt backlogged records first (FIFO — they
@@ -823,14 +879,69 @@ class ContinuousWorker:
     def _get_prefix(self, prefix_ids: list[int]):
         """Retained prefix for these tokens, building (and LRU-evicting)
         on first use. Build cost is one prefill — paid once per distinct
-        prefix, amortized over every request that shares it."""
+        prefix, amortized over every request that shares it. With a
+        tiered store, a local miss first tries PROMOTION (the blob a
+        peer — or this worker's own eviction — demoted) before paying
+        the prefill, and the LRU's evictions DEMOTE instead of drop."""
         key = tuple(prefix_ids)
         pfx = self._prefixes.pop(key, None)
+        if pfx is None and self.kvstore is not None:
+            with trace.span(
+                "-", "kv_promote", worker=self.worker_id,
+                n_tokens=len(prefix_ids),
+            ):
+                pfx = self.kvstore.fetch_prefix(
+                    prefix_ids, max_seq_len=self.engine.max_seq_len,
+                )
         if pfx is None:
             pfx = self.engine.build_prefix(list(prefix_ids))
         self._prefixes[key] = pfx  # most-recently-used at the end
         while len(self._prefixes) > self.max_prefixes:
-            self._prefixes.pop(next(iter(self._prefixes)))
+            old = self._prefixes.pop(next(iter(self._prefixes)))
+            self._on_demote(old)
+        return pfx
+
+    # -- KV tiering (serve/kvstore.py) ---------------------------------------
+
+    def _on_demote(self, prefix) -> None:
+        """Eviction hook (batcher pool + dense prefix LRU): hand the
+        evicted ``Prefix`` to the store's async demote queue."""
+        if self.kvstore is not None:
+            self.kvstore.demote_prefix(prefix, self.engine.block_size)
+
+    def _on_park(self, req_id: str, tokens, blocks) -> None:
+        """Batcher finish hook: a session turn completed — park its
+        exported KV under the session key for the next turn."""
+        sid = self._park_sessions.pop(req_id, None)
+        if sid is None or self.kvstore is None:
+            return
+        with trace.span(
+            req_id, "kv_park", worker=self.worker_id,
+            n_tokens=len(tokens),
+        ):
+            self.kvstore.park_session(
+                sid, tokens, blocks, self.engine.block_size
+            )
+
+    def _resume_session(self, session_id: str, ids: list[int]):
+        """Parked-KV resume: consume the session blob and rebuild a
+        seedable ``Prefix`` when the parked tokens properly prefix the
+        new turn's prompt; None (and the blob stays consumed only on a
+        match) otherwise."""
+        parked = self.kvstore.resume_session(session_id, token_ids=ids)
+        if parked is None:
+            return None
+        tokens, blocks = parked
+        from llmss_tpu.serve.kvstore import prefix_from_blocks
+
+        with trace.span(
+            "-", "kv_resume", worker=self.worker_id,
+            n_tokens=len(tokens),
+        ):
+            pfx = prefix_from_blocks(
+                tokens, blocks, max_seq_len=self.engine.max_seq_len,
+            )
+        self.kvstore.note_reprefill_avoided(len(tokens))
         return pfx
 
     def begin_drain(self) -> None:
@@ -1009,6 +1120,16 @@ def main(argv=None):
              "(routers treat a worker as stale after 3x this)",
     )
     parser.add_argument(
+        "--kv_tier_host_mb", type=float, default=None,
+        help="enable the tiered KV store (docs/paged-kv.md 'KV tiers') "
+             "with this many MB of host RAM as tier T1; the broker's "
+             "Redis doubles as the fleet-wide T2 blob store. Evicted "
+             "prefixes demote instead of dropping, shared-prefix misses "
+             "promote from the tiers, and multi-turn sessions park their "
+             "KV between turns (zero re-prefill on resume). Requires "
+             "--continuous",
+    )
+    parser.add_argument(
         "--supervise", action="store_true",
         help="run under the crash-restart supervisor (heartbeats + capped "
              "exponential backoff)",
@@ -1036,6 +1157,8 @@ def main(argv=None):
             parser.error("--chunked_prefill requires --continuous")
         if args.kv_layout != "paged":
             parser.error("--chunked_prefill requires --kv_layout paged")
+    if args.kv_tier_host_mb is not None and not args.continuous:
+        parser.error("--kv_tier_host_mb requires --continuous")
 
     from transformers import AutoTokenizer
 
@@ -1063,6 +1186,22 @@ def main(argv=None):
         worker_id=args.worker_id,
     )
 
+    kvstore = None
+    if args.kv_tier_host_mb is not None:
+        from llmss_tpu.serve.kvstore import (
+            HostKVStore, RedisBlobStore, TieredKVStore,
+        )
+
+        kvstore = TieredKVStore(
+            host=HostKVStore(
+                cap_bytes=int(args.kv_tier_host_mb * 1024 * 1024)
+            ),
+            # The broker's (retry-wrapped) client doubles as T2; the
+            # ":kv:" key segment keeps the blob family clear of every
+            # broker key family under the same queue namespace.
+            blob=RedisBlobStore(broker._r, namespace="pqueue"),
+        )
+
     def make_worker():
         if args.continuous:
             w = ContinuousWorker(
@@ -1073,6 +1212,7 @@ def main(argv=None):
                 snapshot_interval_s=args.snapshot_interval_s,
                 role=args.role,
                 chunked_prefill=args.chunked_prefill,
+                kvstore=kvstore,
             )
         else:
             w = Worker(

@@ -123,8 +123,35 @@ def fleet_status(
         "queue_depth": broker.queue_depth(),
         "handoff_depth": broker.handoff_depth(),
     }
+    tiers = aggregate_kv_tiers(
+        info.get("kv_tiers") for info in workers.values()
+    )
+    if tiers:
+        # Fleet-wide tier residency: per-worker blocks summed (the T2
+        # counters are per-worker VIEWS of the shared store — sums count
+        # traffic, not distinct blobs).
+        out["kv_tiers"] = tiers
     if router is not None:
         out["router"] = router.stats()
+    return out
+
+
+def aggregate_kv_tiers(blobs) -> dict:
+    """Sum per-worker ``kv_tiers`` stats blocks (serve/kvstore.py) into
+    one fleet-wide view — numeric leaves add, nested dicts recurse, and
+    workers without a store contribute nothing."""
+    out: dict = {}
+
+    def fold(dst: dict, src: dict) -> None:
+        for k, v in src.items():
+            if isinstance(v, dict):
+                fold(dst.setdefault(k, {}), v)
+            elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                dst[k] = dst.get(k, 0) + v
+
+    for blob in blobs:
+        if isinstance(blob, dict):
+            fold(out, blob)
     return out
 
 

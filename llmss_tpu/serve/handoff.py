@@ -89,9 +89,15 @@ def _dtype_of(name: str):
 
 def encode_blocks(
     blocks: dict, *, req_id: str, n_tokens: int, block_size: int,
-    trace_id: str | None = None,
+    trace_id: str | None = None, tokens: list[int] | None = None,
 ) -> bytes:
-    """Serialize an ``export_blocks`` dict into the handoff wire format."""
+    """Serialize an ``export_blocks`` dict into the handoff wire format.
+
+    ``tokens`` (optional) embeds the segment's token ids in the header —
+    the tiered KV store (serve/kvstore.py) uses it to make at-rest blobs
+    self-describing. The key is absent when not provided, so handoff
+    payloads are byte-identical to before and old decoders keep working
+    (version unchanged)."""
     bufs: list[bytes] = []
     shapes: dict[str, list[int] | None] = {}
     dtypes: dict[str, str | None] = {}
@@ -116,6 +122,7 @@ def encode_blocks(
         "shapes": shapes,
         "dtypes": dtypes,
         "crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+        **({"tokens": [int(t) for t in tokens]} if tokens is not None else {}),
     }).encode("utf-8")
     return _MAGIC + struct.pack("<I", len(header)) + header + raw
 
@@ -153,6 +160,9 @@ def decode_blocks(data: bytes) -> dict:
         "n_tokens": header["n_tokens"],
         "block_size": header["block_size"],
         "quantized": header["quantized"],
+        # Token ids ride only in at-rest tier blobs (serve/kvstore.py);
+        # None on plain handoff payloads.
+        "tokens": header.get("tokens"),
     }
     off = 0
     for name in _ARRAYS:

@@ -1,0 +1,552 @@
+"""Tiered fleet-wide KV store: device → host RAM → fleet blob store.
+
+COW shared prefixes (engine/scheduler.py) are only warm on the replica
+that built them, and pool pressure evicts them to NOTHING — a later
+request sharing the prefix re-prefills from scratch. This module adds
+the two tiers below the device pool:
+
+- **T0 — device**: the existing ``PagedKVCache`` block pool (and the
+  dense prefix LRU in ``ContinuousWorker``). Not owned here; this module
+  is where KV goes when T0 lets go of it and where T0 refills from.
+- **T1 — host RAM** (:class:`HostKVStore`): LKVH blobs in an LRU dict
+  capped by bytes. Demotions land here first; overflow spills to T2 (or
+  drops, counted, when no T2 is configured).
+- **T2 — fleet blob store** (:class:`InProcBlobStore` /
+  :class:`RedisBlobStore`): fleet-wide, keyed by ``prefix_hash`` /
+  ``session_id``, mirroring the broker's dual-backend pattern — the same
+  blob is fetchable by EVERY worker, which is what turns a per-worker
+  prefix cache into a fleet-wide one.
+
+The at-rest format IS the wire format: ``serve/handoff.py``'s LKVH
+encoding (magic + JSON header + raw little-endian buffers + CRC-32),
+extended with the prefix's token ids in the header so a fetched blob is
+self-describing. bf16 round-trips bit-exactly via ml_dtypes and
+int8+scales likewise, so a demoted-then-promoted prefix seeds the exact
+bytes the original prefill wrote — streams are bit-identical to the
+never-evicted run (tests/test_kvstore.py).
+
+Lifecycle verbs (docs/paged-kv.md "KV tiers"):
+
+- **demote** — ``ContinuousBatcher._paged_evict_idle_prefixes`` (and the
+  worker's dense prefix LRU) hand the evicted :class:`Prefix` to
+  :meth:`TieredKVStore.demote_prefix`; encoding happens on a background
+  thread, OFF the dispatch path — the pool blocks are freed immediately
+  because the ``Prefix`` owns its own arrays.
+- **promote** — a prefix-affinity miss lands the request on a worker
+  whose T0 is cold; ``ContinuousWorker._get_prefix`` calls
+  :meth:`TieredKVStore.fetch_prefix`, decodes the blob back into a
+  ``Prefix`` (bucket-padded so the prewarmed seed executables are
+  reused — zero steady-state recompiles), and admission proceeds as a
+  prefix hit: only the suffix prefills.
+- **park / resume** — a multi-turn session's finished row exports its
+  full blocks (scheduler finish hook) into ``sess:{session_id}``; the
+  next turn of the session resumes by seeding from the parked KV with
+  zero re-prefill of the earlier turns.
+
+Threading: the host store is written by the demote thread and read by
+the serving thread and metrics/heartbeat threads — all state is
+lock-guarded (graftlint ``guarded_by:`` discipline).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from llmss_tpu.serve.handoff import decode_blocks, encode_blocks
+from llmss_tpu.serve.protocol import prefix_hash
+
+__all__ = [
+    "HostKVStore",
+    "InProcBlobStore",
+    "RedisBlobStore",
+    "TieredKVStore",
+    "blocks_from_prefix",
+    "prefix_from_blocks",
+    "encode_prefix",
+    "decode_prefix",
+    "prefix_key",
+    "session_key",
+]
+
+
+def prefix_key(token_ids) -> str:
+    """Store key for a shared-prefix blob (fleet-wide: any worker that
+    hashes the same tokens finds the same blob)."""
+    return "prefix:" + prefix_hash(list(token_ids))
+
+
+def session_key(session_id: str) -> str:
+    return "sess:" + str(session_id)
+
+
+# -- Prefix <-> LKVH blocks ----------------------------------------------------
+
+
+def blocks_from_prefix(prefix, block_size: int) -> tuple[dict, int]:
+    """Reshape a device ``Prefix`` into the ``export_blocks`` dict layout
+    ``[L, nb, bs, ...]`` for LKVH encoding.
+
+    The prefix arrays are BUCKET-padded (``_bucket(P, max_seq_len)``
+    slots); pad content is whatever the builder's cache row held, so it
+    is sliced off FIRST and the tail re-padded with zeros — identical
+    token ids must produce identical bytes (the same determinism rule as
+    ``export_blocks``). Returns ``(blocks, n_tokens)``.
+    """
+    n = prefix.length
+    nb = -(-n // block_size)  # ceil
+
+    def shape_blocks(a):
+        if a is None:
+            return None
+        a = np.asarray(a)  # device -> host
+        a = a[:, :n]  # drop bucket padding (stale cache-row content)
+        pad = nb * block_size - n
+        if pad:
+            widths = [(0, 0), (0, pad)] + [(0, 0)] * (a.ndim - 2)
+            a = np.pad(a, widths)
+        return a.reshape((a.shape[0], nb, block_size) + a.shape[2:])
+
+    return {
+        "k": shape_blocks(prefix.k),
+        "v": shape_blocks(prefix.v),
+        "k_scale": shape_blocks(prefix.k_scale),
+        "v_scale": shape_blocks(prefix.v_scale),
+    }, n
+
+
+def prefix_from_blocks(tokens, blocks: dict, *, max_seq_len: int):
+    """Rebuild a device ``Prefix`` from an LKVH block payload.
+
+    The arrays are re-padded to the SAME bucket shape ``_bucket(n,
+    max_seq_len)`` that ``engine.build_prefix`` would have produced, so
+    ``seed_cache`` reuses the prewarmed seed executables — promotion
+    costs a host->device copy, never a compile. Pad slots carry no
+    positions, so their (zero) content is masked out of attention: the
+    seeded row is stream-equivalent to one seeded from the original
+    prefix.
+    """
+    import jax.numpy as jnp
+
+    from llmss_tpu.engine.engine import Prefix, _bucket
+
+    tokens = tuple(int(t) for t in tokens)
+    n = len(tokens)
+    pb = _bucket(n, max_seq_len)
+
+    def unfold(a):
+        if a is None:
+            return None
+        a = np.asarray(a)
+        flat = a.reshape((a.shape[0], a.shape[1] * a.shape[2]) + a.shape[3:])
+        flat = flat[:, :n]
+        pad = pb - n
+        if pad:
+            widths = [(0, 0), (0, pad)] + [(0, 0)] * (flat.ndim - 2)
+            flat = np.pad(flat, widths)
+        return jnp.asarray(flat)
+
+    return Prefix(
+        tokens=tokens,
+        k=unfold(blocks["k"]),
+        v=unfold(blocks["v"]),
+        k_scale=unfold(blocks.get("k_scale")),
+        v_scale=unfold(blocks.get("v_scale")),
+    )
+
+
+def encode_prefix(prefix, block_size: int) -> bytes:
+    """Prefix -> self-describing LKVH blob (token ids ride the header)."""
+    blocks, n = blocks_from_prefix(prefix, block_size)
+    return encode_blocks(
+        blocks, req_id=prefix_key(prefix.tokens), n_tokens=n,
+        block_size=block_size, tokens=list(prefix.tokens),
+    )
+
+
+def decode_prefix(payload: bytes, *, max_seq_len: int):
+    """LKVH blob -> device ``Prefix``. Raises ``ValueError`` on a corrupt
+    payload or one encoded without token ids (not a prefix blob)."""
+    d = decode_blocks(payload)
+    if d.get("tokens") is None:
+        raise ValueError("not a prefix blob: no token ids in header")
+    if len(d["tokens"]) != d["n_tokens"]:
+        raise ValueError("corrupt prefix blob: token count mismatch")
+    blocks = {k: d[k] for k in ("k", "v", "k_scale", "v_scale")}
+    return prefix_from_blocks(d["tokens"], blocks, max_seq_len=max_seq_len)
+
+
+# -- T1: host RAM --------------------------------------------------------------
+
+
+class HostKVStore:
+    """Byte-capped LRU of LKVH blobs in host RAM (tier T1).
+
+    Overflow policy: the least-recently-used blob spills through
+    ``spill_cb`` (T2 put) when one is configured, else it drops —
+    counted either way, never silent.
+    """
+
+    def __init__(self, cap_bytes: int = 1 << 30, spill_cb=None):
+        self.cap_bytes = int(cap_bytes)
+        self.spill_cb = spill_cb
+        self._lock = threading.Lock()
+        self._map: OrderedDict[str, bytes] = OrderedDict()  # guarded_by: self._lock
+        self._bytes = 0  # guarded_by: self._lock
+        self.hits = 0  # guarded_by: self._lock
+        self.misses = 0  # guarded_by: self._lock
+        self.spilled = 0  # guarded_by: self._lock
+        self.dropped = 0  # guarded_by: self._lock
+
+    def put(self, key: str, payload: bytes) -> None:
+        """Insert/refresh ``key``; evicts LRU entries past the cap. A
+        payload larger than the whole cap spills/drops immediately."""
+        overflow: list[tuple[str, bytes]] = []
+        with self._lock:
+            old = self._map.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old)
+            if len(payload) > self.cap_bytes:
+                overflow.append((key, payload))
+            else:
+                self._map[key] = payload
+                self._bytes += len(payload)
+                while self._bytes > self.cap_bytes:
+                    k, v = self._map.popitem(last=False)
+                    self._bytes -= len(v)
+                    overflow.append((k, v))
+        # Spill outside the lock: a T2 put (Redis round-trip) must never
+        # block readers of the host map.
+        for k, v in overflow:
+            if self.spill_cb is not None:
+                self.spill_cb(k, v)
+                with self._lock:
+                    self.spilled += 1
+            else:
+                with self._lock:
+                    self.dropped += 1
+
+    def get(self, key: str) -> bytes | None:
+        with self._lock:
+            payload = self._map.pop(key, None)
+            if payload is None:
+                self.misses += 1
+                return None
+            self._map[key] = payload  # most-recently-used at the end
+            self.hits += 1
+            return payload
+
+    def pop(self, key: str) -> bytes | None:
+        with self._lock:
+            payload = self._map.pop(key, None)
+            if payload is not None:
+                self._bytes -= len(payload)
+            return payload
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return list(self._map)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "bytes": self._bytes,
+                "cap_bytes": self.cap_bytes,
+                "entries": len(self._map),
+                "hits": self.hits,
+                "misses": self.misses,
+                "spilled": self.spilled,
+                "dropped": self.dropped,
+            }
+
+
+# -- T2: fleet blob store ------------------------------------------------------
+
+
+class InProcBlobStore:
+    """In-process T2 backend (single-process fleets, tests, the
+    simulator) — same contract as :class:`RedisBlobStore`."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._map: dict[str, bytes] = {}  # guarded_by: self._lock
+        self.puts = 0  # guarded_by: self._lock
+        self.hits = 0  # guarded_by: self._lock
+        self.misses = 0  # guarded_by: self._lock
+
+    def put(self, key: str, payload: bytes) -> None:
+        with self._lock:
+            self._map[key] = bytes(payload)
+            self.puts += 1
+
+    def get(self, key: str) -> bytes | None:
+        with self._lock:
+            payload = self._map.get(key)
+            if payload is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+            return payload
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._map.pop(key, None)
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return list(self._map)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._map),
+                "puts": self.puts,
+                "hits": self.hits,
+                "misses": self.misses,
+            }
+
+
+class RedisBlobStore:
+    """Redis-backed T2: blobs as raw bytes under ``{namespace}:kv:{key}``
+    — the broker's namespace with a dedicated segment, so a shared Redis
+    carries queues and KV side by side without key collisions. Works
+    against real redis-py and ``serve.chaos.FakeRedis`` alike."""
+
+    def __init__(self, client, namespace: str = "llmss"):
+        self.r = client
+        self.ns = namespace
+        self._lock = threading.Lock()
+        self.puts = 0  # guarded_by: self._lock
+        self.hits = 0  # guarded_by: self._lock
+        self.misses = 0  # guarded_by: self._lock
+
+    def _key(self, key: str) -> str:
+        return f"{self.ns}:kv:{key}"
+
+    def put(self, key: str, payload: bytes) -> None:
+        self.r.set(self._key(key), bytes(payload))
+        with self._lock:
+            self.puts += 1
+
+    def get(self, key: str) -> bytes | None:
+        payload = self.r.get(self._key(key))
+        with self._lock:
+            if payload is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+        return payload
+
+    def delete(self, key: str) -> None:
+        self.r.delete(self._key(key))
+
+    def keys(self) -> list[str]:
+        pat = f"{self.ns}:kv:*"
+        strip = len(f"{self.ns}:kv:")
+        out = []
+        for k in self.r.scan_iter(match=pat):
+            if isinstance(k, bytes):
+                k = k.decode()
+            out.append(k[strip:])
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self.keys()),
+                "puts": self.puts,
+                "hits": self.hits,
+                "misses": self.misses,
+            }
+
+
+# -- the tiered facade ---------------------------------------------------------
+
+
+class TieredKVStore:
+    """T1+T2 facade with the demote/promote/park lifecycle.
+
+    - ``demote_prefix`` is ASYNC: the serving thread enqueues the evicted
+      ``Prefix`` (which owns its arrays — the pool blocks are already
+      free) and a daemon thread does the device->host copy + LKVH encode.
+      ``flush()`` joins the queue — tests and drain paths use it for
+      deterministic visibility.
+    - ``fetch_prefix`` is SYNC on the serving thread (the request needs
+      the KV now); a T2 hit re-warms T1 on the way up.
+    - ``park_session``/``resume_session`` store a finished turn's full
+      (tokens, blocks) under ``sess:{id}``; resume CONSUMES the blob —
+      the resumed row's KV diverges from the parked copy immediately, so
+      a stale second resume must re-prefill, not adopt.
+
+    ``fault_hook(stage, key)`` is the chaos surface (mirrors
+    ``FakeRedis.fault_hook``): called around tier transfers so
+    ``tools/chaos_serve.py --fault kill-mid-promotion`` can kill the
+    worker at the exact hazard point.
+    """
+
+    def __init__(self, host: HostKVStore | None = None, blob=None):
+        self.blob = blob
+        self.host = host or HostKVStore(
+            spill_cb=blob.put if blob is not None else None
+        )
+        if host is not None and blob is not None and host.spill_cb is None:
+            host.spill_cb = blob.put
+        self.fault_hook = None  # chaos: fault_hook(stage, key)
+        self._lock = threading.Lock()
+        self.prefix_demotes = 0  # guarded_by: self._lock
+        self.prefix_promotes = 0  # guarded_by: self._lock
+        self.prefix_demote_errors = 0  # guarded_by: self._lock
+        self.sessions_parked = 0  # guarded_by: self._lock
+        self.sessions_resumed = 0  # guarded_by: self._lock
+        self.reprefill_tokens_avoided = 0  # guarded_by: self._lock
+        self._q: queue.Queue = queue.Queue()
+        self._worker = threading.Thread(
+            target=self._demote_loop, name="kvstore-demote", daemon=True,
+        )
+        self._worker.start()
+
+    # -- raw blob plane --------------------------------------------------------
+
+    def put_blob(self, key: str, payload: bytes) -> None:
+        """T1 insert (LRU overflow spills to T2 via the host store)."""
+        self.host.put(key, payload)
+
+    def get_blob(self, key: str) -> bytes | None:
+        """T1 lookup, falling through to T2; a T2 hit re-warms T1."""
+        payload = self.host.get(key)
+        if payload is not None:
+            return payload
+        if self.blob is None:
+            return None
+        if self.fault_hook is not None:
+            self.fault_hook("t2_get", key)  # chaos: kill mid-tier-fetch
+        payload = self.blob.get(key)
+        if payload is not None:
+            self.host.put(key, payload)
+        return payload
+
+    def delete_blob(self, key: str) -> None:
+        self.host.pop(key)
+        if self.blob is not None:
+            self.blob.delete(key)
+
+    # -- prefix lifecycle ------------------------------------------------------
+
+    def demote_prefix(self, prefix, block_size: int) -> None:
+        """Queue an evicted ``Prefix`` for encoding into T1/T2 (async,
+        off the dispatch path)."""
+        self._q.put((prefix, int(block_size)))
+
+    def _demote_loop(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                prefix, block_size = item
+                payload = encode_prefix(prefix, block_size)
+                self.put_blob(prefix_key(prefix.tokens), payload)
+                with self._lock:
+                    self.prefix_demotes += 1
+            except Exception:  # noqa: BLE001 — a failed demote is a drop, not a crash
+                with self._lock:
+                    self.prefix_demote_errors += 1
+            finally:
+                self._q.task_done()
+
+    def flush(self) -> None:
+        """Block until every queued demotion has landed in the store."""
+        self._q.join()
+
+    def fetch_prefix(self, token_ids, *, max_seq_len: int):
+        """Promote: look the prefix up by token hash and rebuild the
+        device ``Prefix``, or None on a fleet-wide miss. A corrupt blob
+        is deleted (the caller re-prefills) rather than raised."""
+        key = prefix_key(token_ids)
+        payload = self.get_blob(key)
+        if payload is None:
+            return None
+        try:
+            pfx = decode_prefix(payload, max_seq_len=max_seq_len)
+            if pfx.tokens != tuple(int(t) for t in token_ids):
+                raise ValueError("prefix blob token mismatch (hash collision?)")
+        except ValueError:
+            self.delete_blob(key)
+            return None
+        with self._lock:
+            self.prefix_promotes += 1
+        return pfx
+
+    # -- session parking -------------------------------------------------------
+
+    def park_session(
+        self, session_id: str, tokens, blocks: dict, block_size: int,
+    ) -> None:
+        """Store a finished turn's exported blocks under the session key
+        (called from the scheduler finish hook — ``blocks`` is already a
+        host-side ``export_blocks`` dict, so encoding here is cheap)."""
+        toks = [int(t) for t in tokens]
+        payload = encode_blocks(
+            blocks, req_id=session_key(session_id), n_tokens=len(toks),
+            block_size=int(block_size), tokens=toks,
+        )
+        self.put_blob(session_key(session_id), payload)
+        with self._lock:
+            self.sessions_parked += 1
+
+    def resume_session(self, session_id: str, token_ids=None):
+        """Consume the parked KV for ``session_id``: returns ``(tokens,
+        blocks)`` or None. When ``token_ids`` (the new turn's prompt) is
+        given, the blob is consumed ONLY if the parked tokens are a
+        proper prefix of it — a mismatched turn (edited history) leaves
+        the blob in place and re-prefills. On a match the blob leaves
+        every tier: the resumed row's KV diverges from the parked copy
+        immediately, so a second resume must not adopt it."""
+        key = session_key(session_id)
+        payload = self.get_blob(key)
+        if payload is None:
+            return None
+        try:
+            d = decode_blocks(payload)
+        except ValueError:
+            self.delete_blob(key)
+            return None
+        if d.get("tokens") is None:
+            self.delete_blob(key)
+            return None
+        tokens = [int(t) for t in d["tokens"]]
+        if token_ids is not None:
+            ids = [int(t) for t in token_ids]
+            if len(tokens) >= len(ids) or ids[: len(tokens)] != tokens:
+                return None  # not this turn's history — keep the blob
+        self.delete_blob(key)
+        blocks = {k: d[k] for k in ("k", "v", "k_scale", "v_scale")}
+        with self._lock:
+            self.sessions_resumed += 1
+        return tokens, blocks
+
+    def note_reprefill_avoided(self, n_tokens: int) -> None:
+        with self._lock:
+            self.reprefill_tokens_avoided += int(n_tokens)
+
+    # -- observability ---------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Per-tier residency + lifecycle counters. Numeric leaves only:
+        the payload renders straight into Prometheus families via
+        ``metrics.render_prometheus`` and aggregates by summation in
+        ``fleet.fleet_status``."""
+        with self._lock:
+            life = {
+                "prefix_demotes": self.prefix_demotes,
+                "prefix_promotes": self.prefix_promotes,
+                "prefix_demote_errors": self.prefix_demote_errors,
+                "sessions_parked": self.sessions_parked,
+                "sessions_resumed": self.sessions_resumed,
+                "reprefill_tokens_avoided": self.reprefill_tokens_avoided,
+            }
+        out = {"t1": self.host.stats(), **life}
+        if self.blob is not None:
+            out["t2"] = self.blob.stats()
+        return out

@@ -816,6 +816,15 @@ class ProducerServer:
         }
         if self.router is not None:
             out["router"] = self.router.stats()
+        from llmss_tpu.serve.fleet import aggregate_kv_tiers
+
+        tiers = aggregate_kv_tiers(
+            info.get("kv_tiers") for info in workers.values()
+        )
+        if tiers:
+            # KV tiering rollup: only present when a worker runs a tiered
+            # store — the pre-tiering payload stays byte-identical.
+            out["kv_tiers"] = tiers
         return out
 
     def worker_unavailable(self) -> str | None:
@@ -1056,6 +1065,13 @@ def create_fastapi_app(broker: Broker, timeout_s: float = 300.0,
             }
             if router is not None:
                 fleet["router"] = router.stats()
+            from llmss_tpu.serve.fleet import aggregate_kv_tiers
+
+            tiers = aggregate_kv_tiers(
+                info.get("kv_tiers") for info in workers.values()
+            )
+            if tiers:
+                fleet["kv_tiers"] = tiers
             payload["fleet"] = fleet
         dt = collect_devtel_exports(broker)
         if dt:

@@ -115,11 +115,17 @@ class GenerateRequest:
     # unpreempted run because sampling depends only on (seed, position).
     resume_tokens: list[int] | None = None
     # Session identity (optional, client- or producer-stamped): groups
-    # the requests of one conversation. Purely observational on the
-    # serving path — it rides trace enqueue attrs into
+    # the requests of one conversation. Rides trace enqueue attrs into
     # ``/trace/export_workload`` so a replay can reproduce per-session
-    # arrival structure (and prefix-affinity pressure) from a capture.
+    # arrival structure, AND keys the tiered KV store's session parking
+    # (serve/kvstore.py): a worker with a store parks the finished
+    # turn's KV under this id and the next turn resumes from it with
+    # zero re-prefill of the earlier turns.
     session_id: str | None = None
+    # Turn ordinal within the session (optional, 0-based): observational
+    # — stamped into workload exports so replayed chat traffic keeps its
+    # per-session turn ordering (tools/trace_workload.py).
+    turn: int | None = None
     id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
 
     def to_json(self) -> str:

@@ -40,6 +40,7 @@ class DeviceCostModel:
         "prefill_token_s", "decode_step_s", "adopt_const_s",
         "kv_bytes_per_token", "wire_gbps", "bucket_compile_s",
         "prewarm_max_bucket", "block_size", "kv_blocks_total",
+        "t1_fetch_const_s", "t1_gbps", "t2_fetch_const_s", "t2_gbps",
         "seeded_from",
     )
 
@@ -55,6 +56,10 @@ class DeviceCostModel:
         prewarm_max_bucket: int = 128,
         block_size: int = 16,
         kv_blocks_total: int = 4096,
+        t1_fetch_const_s: float = 0.2e-3,
+        t1_gbps: float = 50.0,
+        t2_fetch_const_s: float = 2e-3,
+        t2_gbps: float = 10.0,
         seeded_from: str = "table",
     ):
         self.prefill_token_s = float(prefill_token_s)
@@ -66,6 +71,15 @@ class DeviceCostModel:
         self.prewarm_max_bucket = int(prewarm_max_bucket)
         self.block_size = int(block_size)
         self.kv_blocks_total = int(kv_blocks_total)
+        # KV tier fetch pricing (serve/kvstore.py's T1 host RAM / T2
+        # fleet blob store): constant setup + KV bytes over the tier's
+        # effective bandwidth. T1 is a host→device copy; T2 adds the
+        # blob-store round trip — slower but still far cheaper than
+        # re-prefilling the tokens it carries.
+        self.t1_fetch_const_s = float(t1_fetch_const_s)
+        self.t1_gbps = float(t1_gbps)
+        self.t2_fetch_const_s = float(t2_fetch_const_s)
+        self.t2_gbps = float(t2_gbps)
         self.seeded_from = seeded_from
 
     # -- seeding --------------------------------------------------------------
@@ -170,6 +184,17 @@ class DeviceCostModel:
     def handoff_bytes(self, n_tokens: int) -> int:
         return int(n_tokens * self.kv_bytes_per_token)
 
+    def tier_fetch_s(self, n_tokens: int, tier: str) -> float:
+        """Promotion cost: pull ``n_tokens`` of parked KV back onto the
+        device from host RAM (``t1``) or the fleet blob store (``t2``)."""
+        if tier == "t1":
+            const, gbps = self.t1_fetch_const_s, self.t1_gbps
+        elif tier == "t2":
+            const, gbps = self.t2_fetch_const_s, self.t2_gbps
+        else:
+            raise ValueError(f"unknown KV tier {tier!r}")
+        return const + (n_tokens * self.kv_bytes_per_token) / (gbps * 1e9)
+
     def kv_blocks(self, plen: int, max_new: int) -> int:
         return math.ceil((plen + max_new) / self.block_size)
 
@@ -183,4 +208,8 @@ class DeviceCostModel:
             "wire_gbps": self.wire_gbps,
             "block_size": self.block_size,
             "kv_blocks_total": self.kv_blocks_total,
+            "t1_fetch_const_s": self.t1_fetch_const_s,
+            "t1_gbps": self.t1_gbps,
+            "t2_fetch_const_s": self.t2_fetch_const_s,
+            "t2_gbps": self.t2_gbps,
         }

@@ -74,6 +74,12 @@ class InvariantChecker:
         self._known_workers: set[str] = set()
         self._ctrl_spawned: set[str] = set()
         self._ctrl_draining: set[str] = set()
+        # KV tier digests (catalog item 10): what each demoted/parked
+        # blob held when it entered the store, so a later promotion can
+        # be checked bit-exact (the sim's digest is the token count; the
+        # real store CRCs the bytes).
+        self._tier_digest: dict[str, int] = {}
+        self._tier_store = None
 
     # -- wiring ---------------------------------------------------------------
 
@@ -219,6 +225,33 @@ class InvariantChecker:
                 f"({remaining} < {floor})"
             )
 
+    # -- KV tier store (catalog item 10) --------------------------------------
+    #
+    # 10. demote-then-promote is bit-exact and tier accounts balance: a
+    #     promotion must hand back exactly the blob that was demoted or
+    #     parked (digest match — a store that silently truncates or
+    #     swaps blobs re-prefills wrong KV), and the store's own token
+    #     gauges must reconcile with its entries at drain.
+
+    def attach_tier_store(self, store) -> None:
+        """Register the fleet tier store for the drain-time audit."""
+        self._tier_store = store
+
+    def tier_put(self, key: str, n_tokens: int) -> None:
+        self._tier_digest[key] = int(n_tokens)
+
+    def tier_get(self, key: str, n_tokens: int) -> None:
+        want = self._tier_digest.get(key)
+        if want is None:
+            self._violations.append(
+                f"tier promotion of {key} that was never demoted"
+            )
+        elif want != int(n_tokens):
+            self._violations.append(
+                f"tier blob {key} corrupt: parked {want} tokens, "
+                f"promoted {int(n_tokens)}"
+            )
+
     # -- KV block accounts ----------------------------------------------------
 
     def kv_alloc(self, account: str, blocks: int) -> None:
@@ -266,6 +299,8 @@ class InvariantChecker:
                 out.append(
                     f"kv account {account} holds {blocks} blocks at drain"
                 )
+        if self._tier_store is not None:
+            out.extend(self._tier_store.audit())
         broker = broker or (self._brokers[0] if self._brokers else None)
         if broker is not None:
             dlq_ids = {row["id"] for row in broker.read_dlq(limit=10_000)}

@@ -50,6 +50,109 @@ from llmss_tpu.serve.protocol import (
 _SIM_PAYLOAD = b"LKVH-sim"
 
 
+class SimTierStore:
+    """Fleet-shared tiered KV model: serve/kvstore.py's T1 host RAM /
+    T2 blob store, priced analytically instead of carrying real KV.
+
+    Entries are ``key -> n_tokens`` (a prefix hash or a ``sess:`` key);
+    the token count doubles as the blob's digest for the invariant
+    checker — a demote-then-promote must hand back exactly the tokens
+    that were parked. T1 is a token-capped LRU whose evictions SPILL to
+    the unbounded T2 (never drop); a T2 hit re-warms T1, mirroring the
+    real store. One instance serves the whole fleet — that is the whole
+    point: a prefix demoted by one replica is a promotion hit for every
+    other.
+    """
+
+    def __init__(self, *, t1_cap_tokens: int = 0, checker=None):
+        self.t1: collections.OrderedDict = collections.OrderedDict()
+        self.t2: dict[str, int] = {}
+        self.t1_cap = int(t1_cap_tokens)
+        self.t1_tokens = 0
+        self.checker = checker
+        self.counters: dict[str, int] = collections.defaultdict(int)
+
+    def put(self, key: str, n_tokens: int) -> None:
+        """Demotion / parking entry point (idempotent per key)."""
+        n_tokens = int(n_tokens)
+        self.counters["puts"] += 1
+        if self.checker is not None:
+            self.checker.tier_put(key, n_tokens)
+        if key in self.t2:
+            self.t2[key] = n_tokens
+            return
+        if key in self.t1:
+            self.t1_tokens += n_tokens - self.t1[key]
+            self.t1[key] = n_tokens
+            self.t1.move_to_end(key)
+        elif n_tokens <= self.t1_cap:
+            self.t1[key] = n_tokens
+            self.t1_tokens += n_tokens
+        else:  # oversized for host RAM: straight to the blob store
+            self.t2[key] = n_tokens
+        while self.t1_tokens > self.t1_cap and self.t1:
+            k, n = self.t1.popitem(last=False)
+            self.t1_tokens -= n
+            self.t2[k] = n  # spill, never drop
+            self.counters["t1_spills"] += 1
+
+    def get(self, key: str) -> tuple[int, str] | None:
+        """Promotion: ``(n_tokens, tier_served_from)`` or None."""
+        if key in self.t1:
+            self.t1.move_to_end(key)
+            n, tier = self.t1[key], "t1"
+        elif key in self.t2:
+            n, tier = self.t2[key], "t2"
+            self.counters["t2_hits"] += 1
+        else:
+            self.counters["misses"] += 1
+            return None
+        self.counters["hits"] += 1
+        if self.checker is not None:
+            self.checker.tier_get(key, n)
+        if tier == "t2":
+            self.put(key, self.t2.pop(key))  # re-warm T1
+            self.counters["puts"] -= 1  # internal move, not a demotion
+        return n, tier
+
+    def pop(self, key: str) -> tuple[int, str] | None:
+        """Consume an entry (session resume semantics)."""
+        got = self.get(key)
+        if got is None:
+            return None
+        if key in self.t1:
+            self.t1_tokens -= self.t1.pop(key)
+        else:
+            self.t2.pop(key, None)
+        return got
+
+    def audit(self) -> list[str]:
+        """Internal-consistency sweep for drain time: the T1 token
+        gauge must equal the sum of its entries (a drifting gauge is a
+        refcount leak wearing a cap)."""
+        out = []
+        if self.t1_tokens != sum(self.t1.values()):
+            out.append(
+                f"tier store T1 gauge {self.t1_tokens} != "
+                f"sum(entries) {sum(self.t1.values())}"
+            )
+        if self.t1_tokens > max(self.t1_cap, 0) and len(self.t1) > 1:
+            out.append(
+                f"tier store T1 over cap at drain ({self.t1_tokens} > "
+                f"{self.t1_cap})"
+            )
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "t1_entries": len(self.t1),
+            "t1_tokens": self.t1_tokens,
+            "t1_cap_tokens": self.t1_cap,
+            "t2_entries": len(self.t2),
+            **{k: self.counters[k] for k in sorted(self.counters)},
+        }
+
+
 class _Row:
     __slots__ = (
         "req", "rec", "total_new", "done", "prefill_left", "blocks",
@@ -107,6 +210,11 @@ class SimReplica:
         # prefix tokens); a miss pays the full prefill and evicts LRU.
         self.prefix_lru_slots = int(prefix_lru_slots)
         self._prefix_lru: collections.OrderedDict = collections.OrderedDict()
+        # Fleet-shared tier store (scenario ``fleet.kv_tiering``): local
+        # LRU evictions demote into it, local misses promote out of it,
+        # finished session turns park in it. None = pre-tiering behavior,
+        # bit-identical — the bench's baseline arm.
+        self.tier: SimTierStore | None = getattr(sim, "tier_store", None)
         self.preempt = bool(preempt)
         # Ship KV-sized handoff payloads so the broker's byte counters
         # reflect real wire volume (PD bench); storms keep the sentinel.
@@ -397,7 +505,7 @@ class SimReplica:
             if self.gen != gen or not self.alive:
                 return  # poison crashed us mid-admission
             self._maybe_preempt()
-            self._admit()
+            busy += self._admit()
             busy += self._work(now + busy)
             self._touch(now)
             self.busy_s += busy
@@ -528,32 +636,76 @@ class SimReplica:
         self.sim.counters["preemptions"] += 1
         self.sim.checker.on_preempt(req.id)
 
-    def _admit(self) -> None:
+    def _admit(self) -> float:
+        """Admit pending rows; returns the virtual seconds spent pulling
+        parked KV out of the tier store (prefix promotions and session
+        resumes are host/blob fetches, charged like adopts)."""
+        busy = 0.0
         while self.pending and len(self.active) < self.rows:
             row = self.pending.popleft()
+            busy += self._resume_session(row)
             if self.prefix_lru_slots:
-                self._attach_prefix(row)
+                busy += self._attach_prefix(row)
             self._take_blocks(row)
             self.active.append(row)
+        return busy
 
-    def _attach_prefix(self, row: _Row) -> None:
+    def _attach_prefix(self, row: _Row) -> float:
         """Prefix-cache admission: a resident prefix COW-attaches (the
-        prefill skips its tokens); a miss prefills everything and the
-        prefix becomes resident, evicting least-recently-used."""
+        prefill skips its tokens); a local miss consults the fleet tier
+        store — a hit there pays the tier fetch instead of the prefill —
+        and a full miss prefills everything. Either way the prefix
+        becomes locally resident, and the LRU eviction it may cause
+        DEMOTES into the store rather than dropping."""
         pref = row.req.prefix_token_ids
         if not pref:
-            return
+            return 0.0
         h = prefix_hash(pref)
         lru = self._prefix_lru
+        busy = 0.0
         if h in lru:
             lru.move_to_end(h)
             self.sim.counters["prefix_hits"] += 1
             row.prefill_left = max(1, row.prefill_left - len(pref))
+            return busy
+        got = self.tier.get(h) if self.tier is not None else None
+        if got is not None:
+            n, tier = got
+            busy = self.cost.tier_fetch_s(n, tier)
+            self.sim.counters["prefix_tier_hits"] += 1
+            self.sim.counters["reprefill_tokens_avoided"] += len(pref)
+            row.prefill_left = max(1, row.prefill_left - len(pref))
         else:
-            lru[h] = True
-            while len(lru) > self.prefix_lru_slots:
-                lru.popitem(last=False)
             self.sim.counters["prefix_misses"] += 1
+        lru[h] = len(pref)
+        while len(lru) > self.prefix_lru_slots:
+            k, n = lru.popitem(last=False)
+            if self.tier is not None:
+                self.tier.put(k, int(n))
+                self.sim.counters["tier_demotes"] += 1
+        return busy
+
+    def _resume_session(self, row: _Row) -> float:
+        """Session resume: a parked earlier turn whose tokens are a
+        proper prefix of this prompt skips their re-prefill, paying the
+        tier fetch instead. Consuming pop — the turn's KV is back on a
+        device and will re-park (longer) when this turn finishes."""
+        tier = self.tier
+        req = row.req
+        if tier is None or not req.session_id or row.is_handoff:
+            return 0.0
+        key = f"sess:{req.session_id}"
+        n = tier.t1.get(key) or tier.t2.get(key)
+        if not n or n >= row.prefill_left:
+            return 0.0  # parked KV doesn't prefix this prompt: leave it
+        got = tier.pop(key)
+        if got is None:
+            return 0.0
+        n, served_from = got
+        row.prefill_left -= n
+        self.sim.counters["sessions_resumed"] += 1
+        self.sim.counters["reprefill_tokens_avoided"] += n
+        return self.cost.tier_fetch_s(n, served_from)
 
     def _split_prefill_cost(self, row: _Row) -> float:
         """The pre-ragged admission path: the whole prompt pads to the
@@ -662,6 +814,15 @@ class SimReplica:
             GenerateResponse(id=req.id, token_ids=tokens)
         )
         self._release_blocks(row)
+        if self.tier is not None and req.session_id:
+            # Park the finished turn's full sequence (prompt + output):
+            # the next turn's prompt extends it, so the resume skips
+            # exactly this many prefill tokens.
+            self.tier.put(
+                f"sess:{req.session_id}",
+                len(req.token_ids or ()) + row.total_new,
+            )
+            self.sim.counters["sessions_parked"] += 1
         if row.first_t is not None:
             self.sim.record_first_token(req, row.first_t)
         self.sim.record_done(req, t_done, row.total_new)

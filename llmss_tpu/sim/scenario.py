@@ -38,7 +38,7 @@ from llmss_tpu.sim.cost import DeviceCostModel
 from llmss_tpu.sim.faults import FaultPlane
 from llmss_tpu.sim.invariants import InvariantChecker
 from llmss_tpu.sim.loop import EventLoop
-from llmss_tpu.sim.replica import SimReplica
+from llmss_tpu.sim.replica import SimReplica, SimTierStore
 from llmss_tpu.utils import trace
 
 SCENARIO_FORMAT = "llmss-scenario/1"
@@ -99,6 +99,19 @@ class FleetSim:
         self.counters: dict[str, int] = collections.defaultdict(int)
 
         fleet = spec.get("fleet") or {}
+        # Fleet-shared tiered KV store (``fleet.kv_tiering`` block,
+        # serve/kvstore.py's sim twin). Built BEFORE the replicas — they
+        # bind ``sim.tier_store`` at construction. ``enabled: false``
+        # keeps the block in the scenario but runs the per-worker-LRU
+        # baseline, which is how the tiering bench builds its arms.
+        kt = fleet.get("kv_tiering") or {}
+        self.tier_store: SimTierStore | None = None
+        if kt and kt.get("enabled", True):
+            self.tier_store = SimTierStore(
+                t1_cap_tokens=int(kt.get("t1_cap_tokens", 4096)),
+                checker=self.checker,
+            )
+            self.checker.attach_tier_store(self.tier_store)
         self.replicas: list[SimReplica] = []
         self.by_wid: dict[str, SimReplica] = {}
         # Provisioned-replica gauge for the autoscale bench's chip-hours
@@ -515,6 +528,32 @@ class FleetSim:
         deadlines = wl.get("deadline_s") or {}
         poison_every = int(wl.get("poison_every", 0))
         sessions = int(wl.get("sessions", 0))
+        # ``session_turns: true`` makes session traffic STRUCTURALLY
+        # multi-turn: each session request after its first carries the
+        # whole earlier conversation (prompt + generated tokens) as
+        # prompt history, the way real chat history accretes — what
+        # exercises session parking/resume. RNG call order is unchanged,
+        # so legacy scenarios without the flag stay byte-identical.
+        session_turns = bool(wl.get("session_turns", False))
+        # Fraction of traffic that is session (chat) traffic when
+        # ``sessions`` is set; the rest is one-shot. Only consulted when
+        # present, so legacy scenarios consume the RNG identically.
+        session_p = wl.get("session_p")
+        sess_len: dict[str, int] = {}
+        sess_turn: dict[str, int] = {}
+        # Shared-prefix population (``prefixes: {count, len}``): one-shot
+        # requests draw one of ``count`` system prompts and carry it as a
+        # prefix_token_ids reuse hint — the traffic that exercises the
+        # per-worker prefix LRU and, through it, the KV tier store.
+        pcfg = wl.get("prefixes") or {}
+        npfx = int(pcfg.get("count", 0))
+        pfx_tokens = [
+            [
+                self.rng.randrange(1, 50_000)
+                for _ in range(int(pcfg.get("len", 32)))
+            ]
+            for _ in range(npfx)
+        ]
         # Diurnal shaping: piecewise-constant rate multipliers
         # [[t_s, mult], ...] — rate_rps is the baseline, each breakpoint
         # rescales it from t_s on. Draw COUNT is unchanged (the
@@ -556,8 +595,30 @@ class FleetSim:
                 slo_class=slo,
                 id=f"s{i:08d}",
             )
-            if sessions:
-                req.session_id = f"sess-{rng.randrange(sessions):05d}"
+            if sessions and (
+                session_p is None or rng.random() < float(session_p)
+            ):
+                sid = f"sess-{rng.randrange(sessions):05d}"
+                req.session_id = sid
+                if session_turns:
+                    t = sess_turn.get(sid, 0)
+                    req.turn = t
+                    sess_turn[sid] = t + 1
+                    hist = sess_len.get(sid, 0)
+                    if hist:
+                        # History token VALUES are inert in the sim
+                        # (payload checks key on the last prompt token);
+                        # only the length — the re-prefill a resume can
+                        # skip — matters.
+                        req.token_ids = [1] * hist + req.token_ids
+                    sess_len[sid] = len(req.token_ids) + mnew
+            if npfx and not req.session_id:
+                # One-shot request under a shared system prompt: the
+                # prefix rides in front of the drawn prompt body, with
+                # the reuse hint the routers/schedulers key on.
+                pref = pfx_tokens[rng.randrange(npfx)]
+                req.token_ids = list(pref) + req.token_ids
+                req.prefix_token_ids = list(pref)
             d = deadlines.get(slo)
             poison = poison_every and (i + 1) % poison_every == 0
             if poison:
@@ -964,6 +1025,27 @@ class FleetSim:
             },
             "cost_model": self.cost.describe(),
         }
+        if self.tier_store is not None:
+            c = self.counters
+            attaches = (
+                c["prefix_hits"] + c["prefix_tier_hits"] + c["prefix_misses"]
+            )
+            out["kv_tiers"] = {
+                **self.tier_store.stats(),
+                "prefix_hits_local": c["prefix_hits"],
+                "prefix_hits_tier": c["prefix_tier_hits"],
+                "prefix_misses": c["prefix_misses"],
+                # Hit rate counting BOTH tiers as hits — the fleet-wide
+                # number the tiering bench compares against the
+                # per-worker-LRU baseline's local-only rate.
+                "fleet_prefix_hit_rate": round(
+                    (c["prefix_hits"] + c["prefix_tier_hits"]) / attaches, 6,
+                ) if attaches else None,
+                "tier_demotes": c["tier_demotes"],
+                "sessions_parked": c["sessions_parked"],
+                "sessions_resumed": c["sessions_resumed"],
+                "reprefill_tokens_avoided": c["reprefill_tokens_avoided"],
+            }
         if self.per_class:
             slo_targets = (self.spec.get("metrics") or {}).get(
                 "ttft_slo_s"

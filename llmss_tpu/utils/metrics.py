@@ -127,6 +127,12 @@ class EngineMetrics:
         self.kv_blocks_total = 0  # guarded_by: self._lock
         self.kv_blocks_in_use = 0  # guarded_by: self._lock
         self.kv_block_evictions = 0  # guarded_by: self._lock
+        # Eviction disposition split (serve/kvstore.py): demoted = the
+        # prefix went DOWN a tier (host/fleet blob) and is promotable;
+        # dropped = evicted to nothing (pre-tiering behavior). The total
+        # above stays their sum for dashboard back-compat.
+        self.kv_evictions_demoted = 0  # guarded_by: self._lock
+        self.kv_evictions_dropped = 0  # guarded_by: self._lock
         # Cost-attribution counters: cumulative block-seconds of pool
         # occupancy (blocks held x wall the row held them — the currency
         # of admission decisions), and finishes broken down by terminal
@@ -186,10 +192,17 @@ class EngineMetrics:
             if in_use is not None:
                 self.kv_blocks_in_use = in_use
 
-    def add_kv_evictions(self, n: int = 1) -> None:
-        """Idle shared-prefix block sets reclaimed to admit new work."""
+    def add_kv_evictions(self, n: int = 1, demoted: bool = False) -> None:
+        """Idle shared-prefix block sets reclaimed to admit new work.
+        ``demoted=True`` means the evicted KV moved down a tier instead
+        of being dropped (serve/kvstore.py); the undifferentiated total
+        keeps counting both."""
         with self._lock:
             self.kv_block_evictions += n
+            if demoted:
+                self.kv_evictions_demoted += n
+            else:
+                self.kv_evictions_dropped += n
 
     def add_kv_block_seconds(self, s: float) -> None:
         """A row released its KV blocks after holding them for
@@ -242,6 +255,9 @@ class EngineMetrics:
                 self.kv_blocks_total, self.kv_blocks_in_use,
                 self.kv_block_evictions,
             )
+            kv_dem, kv_drop = (
+                self.kv_evictions_demoted, self.kv_evictions_dropped,
+            )
             kv_bs = self.kv_block_seconds
             fin = dict(self.finish_classes)
             syncs, groups = self.host_syncs, self.groups_dispatched
@@ -262,6 +278,8 @@ class EngineMetrics:
             "kv_blocks_total": kv_total,
             "kv_blocks_in_use": kv_used,
             "kv_block_evictions": kv_evic,
+            "kv_evictions_demoted": kv_dem,
+            "kv_evictions_dropped": kv_drop,
             "kv_block_seconds": round(kv_bs, 6),
             **({"finish_classes": fin} if fin else {}),
             "tokens_per_sec_lifetime": round(toks / uptime, 2) if uptime else 0,

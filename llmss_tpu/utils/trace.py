@@ -579,15 +579,30 @@ def export_workload(exports) -> dict:
             "prefix_hash": a.get("prefix"),
             "slo_class": a.get("slo_class"),
         }
-        # Optional key (absent in captures that predate session ids) so
-        # legacy workload files stay byte-for-byte reproducible.
+        # Optional keys (absent in captures that predate session ids /
+        # turn ordinals) so legacy workload files stay byte-for-byte
+        # reproducible.
         if a.get("session"):
             row["session_id"] = a["session"]
+            if a.get("turn") is not None:
+                row["turn"] = int(a["turn"])
         rows.append(row)
     rows.sort(key=lambda r: r["_arrival_ts"])
     t0 = rows[0]["_arrival_ts"] if rows else 0.0
     for r in rows:
         r["arrival_s"] = round(r.pop("_arrival_ts") - t0, 6)
+    # Per-session think time: the gap between consecutive turns of one
+    # session (arrival-to-arrival). Stamped per row so a replay — or a
+    # workload synthesized from capture statistics — can reproduce
+    # multi-turn cadence, not just marginal arrival rates.
+    last_arrival: dict[str, float] = {}
+    for r in rows:
+        sid = r.get("session_id")
+        if sid is None:
+            continue
+        if sid in last_arrival:
+            r["think_s"] = round(r["arrival_s"] - last_arrival[sid], 6)
+        last_arrival[sid] = r["arrival_s"]
     return {
         "format": WORKLOAD_FORMAT,
         "n_requests": len(rows),

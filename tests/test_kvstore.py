@@ -1,0 +1,459 @@
+"""Tiered KV store: blob round-trips, LRU demotion, park/resume identity.
+
+The tiered store (``serve/kvstore.py``) ships on four claims, each
+pinned here:
+
+- the at-rest LKVH prefix blob round-trips bit-exactly (bf16 and
+  int8+scales, partial tail block included) through T1 and T2, and a
+  corrupt blob is quarantined, never adopted;
+- T1 is a byte-capped LRU whose overflow spills to T2 in exact LRU
+  order — counted, never silent;
+- a parked session resumes with zero re-prefill and the stream is
+  bit-identical to the never-evicted run (dense greedy AND paged COW),
+  and a promotion-installed prefix leaves the COW refcounts balanced;
+- both T2 backends (``InProcBlobStore`` and ``RedisBlobStore`` over
+  ``FakeRedis``) honor one contract, and tiering adds zero steady-state
+  recompiles under ``CompileGuard``.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from llmss_tpu.serve.broker import InProcBroker
+from llmss_tpu.serve.chaos import FakeRedis
+from llmss_tpu.serve.kvstore import (
+    HostKVStore,
+    InProcBlobStore,
+    RedisBlobStore,
+    TieredKVStore,
+    decode_prefix,
+    encode_prefix,
+    prefix_key,
+)
+from llmss_tpu.serve.protocol import GenerateRequest
+
+# -- T1: cap-enforced LRU demotion order -------------------------------------
+
+
+def test_host_lru_spills_in_lru_order():
+    spilled = []
+    h = HostKVStore(cap_bytes=300, spill_cb=lambda k, v: spilled.append(k))
+    for key in ("a", "b", "c"):
+        h.put(key, key.encode() * 100)
+    assert spilled == []
+    assert h.get("a") is not None  # touch: "a" becomes MRU
+    h.put("d", b"d" * 100)  # over cap -> LRU ("b") spills first
+    h.put("e", b"e" * 100)  # then "c" — never the touched "a"
+    assert spilled == ["b", "c"]
+    assert sorted(h.keys()) == ["a", "d", "e"]
+    st = h.stats()
+    assert st["bytes"] == 300 and st["entries"] == 3
+    assert st["spilled"] == 2 and st["dropped"] == 0
+
+
+def test_host_lru_oversized_payload_spills_straight_through():
+    spilled = []
+    h = HostKVStore(cap_bytes=100, spill_cb=lambda k, v: spilled.append(k))
+    h.put("big", b"x" * 101)  # larger than the whole cap: never resident
+    assert spilled == ["big"] and h.keys() == []
+    assert h.get("big") is None
+
+
+def test_host_lru_drops_are_counted_without_spill_cb():
+    h = HostKVStore(cap_bytes=100)
+    h.put("a", b"x" * 80)
+    h.put("b", b"y" * 80)  # evicts "a" with nowhere to spill
+    assert h.keys() == ["b"]
+    assert h.stats()["dropped"] == 1 and h.stats()["spilled"] == 0
+
+
+def test_tiered_get_falls_through_and_rewarm_t1():
+    blob = InProcBlobStore()
+    store = TieredKVStore(host=HostKVStore(cap_bytes=8), blob=blob)
+    store.put_blob("a", b"A" * 8)
+    store.put_blob("b", b"B" * 8)  # cap fits one: "a" spills to T2
+    assert store.host.keys() == ["b"] and blob.keys() == ["a"]
+    assert store.get_blob("a") == b"A" * 8  # T2 hit...
+    assert store.host.keys() == ["a"]  # ...re-warms T1 ("b" spilled)
+    assert sorted(blob.keys()) == ["a", "b"]
+
+
+# -- T2: dual-backend blob contract ------------------------------------------
+
+
+def make_blob(kind):
+    if kind == "inproc":
+        return InProcBlobStore(), None
+    client = FakeRedis()
+    return RedisBlobStore(client, namespace="t"), client
+
+
+@pytest.mark.parametrize("kind", ("inproc", "fakeredis"))
+def test_blob_store_contract(kind):
+    b, _ = make_blob(kind)
+    assert b.get("k") is None  # miss
+    b.put("k", b"\x00\x01\xff")
+    assert b.get("k") == b"\x00\x01\xff"  # raw bytes round-trip
+    b.put("k", b"v2")
+    assert b.get("k") == b"v2"  # overwrite, not append
+    b.put("sess:1", b"s")
+    assert sorted(b.keys()) == ["k", "sess:1"]
+    b.delete("k")
+    assert b.get("k") is None and b.keys() == ["sess:1"]
+    b.delete("k")  # deleting a missing key is a no-op
+    st = b.stats()
+    assert st["puts"] == 3 and st["hits"] == 2
+    assert st["misses"] == 2 and st["entries"] == 1
+
+
+def test_redis_blob_store_namespaced_off_broker_keys():
+    b, client = make_blob("fakeredis")
+    b.put("prefix:abc", b"blob")
+    # A broker queue key in the same namespace must not leak into the KV
+    # segment's listing — and vice versa.
+    client.set("t:queue:req", b"1")
+    assert b.keys() == ["prefix:abc"]
+    raw = client.get("t:kv:prefix:abc")
+    assert raw == b"blob"
+
+
+# -- at-rest prefix blob: bit-exactness --------------------------------------
+
+
+def _synth_prefix(n, max_seq_len=64, quantized=False, seed=0):
+    """A synthetic device Prefix: [L, P, Hkv, D] arrays (scales
+    [L, P, Hkv]) bucket-padded the way ``engine.build_prefix`` pads, in
+    the exact dtypes the cache uses."""
+    import ml_dtypes
+
+    from llmss_tpu.engine.engine import Prefix, _bucket
+
+    pb = _bucket(n, max_seq_len)
+    rng = np.random.default_rng(seed)
+    shape = (2, pb, 2, 8)
+    if quantized:
+        k = rng.integers(-128, 128, shape, dtype=np.int8)
+        v = rng.integers(-128, 128, shape, dtype=np.int8)
+        ks = rng.standard_normal(shape[:-1], dtype=np.float32)
+        vs = rng.standard_normal(shape[:-1], dtype=np.float32)
+    else:
+        k = rng.standard_normal(shape, np.float32).astype(ml_dtypes.bfloat16)
+        v = rng.standard_normal(shape, np.float32).astype(ml_dtypes.bfloat16)
+        ks = vs = None
+    return Prefix(
+        tokens=tuple(range(1, n + 1)), k=k, v=v, k_scale=ks, v_scale=vs,
+    )
+
+
+def _assert_prefix_bit_exact(a, b, n):
+    """The first ``n`` slots (the live tokens) must match BIT-exactly;
+    pad slots carry no positions and are zeroed by the round-trip."""
+    for name in ("k", "v", "k_scale", "v_scale"):
+        x, y = getattr(a, name), getattr(b, name)
+        if x is None:
+            assert y is None
+            continue
+        x, y = np.asarray(x), np.asarray(y)
+        assert y.dtype == x.dtype and y.shape == x.shape
+        assert y[:, :n].tobytes() == x[:, :n].tobytes()
+
+
+# 32 = two full blocks; 18 = one full block + a 2-slot tail the encoder
+# must zero-pad deterministically.
+@pytest.mark.parametrize("quantized", (False, True))
+@pytest.mark.parametrize("n", (32, 18))
+def test_prefix_blob_roundtrip_bit_exact(quantized, n):
+    pfx = _synth_prefix(n, quantized=quantized)
+    payload = encode_prefix(pfx, block_size=16)
+    rt = decode_prefix(payload, max_seq_len=64)
+    assert rt.tokens == pfx.tokens
+    _assert_prefix_bit_exact(pfx, rt, n)
+    # Same tokens, same arrays -> byte-identical blob (pad slots are
+    # zeroed, not whatever the builder's cache row held).
+    pfx2 = _synth_prefix(n, quantized=quantized)
+    assert encode_prefix(pfx2, block_size=16) == payload
+
+
+@pytest.mark.parametrize("kind", ("inproc", "fakeredis"))
+def test_demote_promote_through_both_tiers_bit_exact(kind):
+    blob, _ = make_blob(kind)
+    # cap 0: every demotion spills straight through T1 into T2, so the
+    # promote below is a genuine fleet-blob fetch.
+    store = TieredKVStore(host=HostKVStore(cap_bytes=0), blob=blob)
+    pfx = _synth_prefix(18)
+    store.demote_prefix(pfx, block_size=16)
+    store.flush()
+    assert store.host.keys() == []
+    assert blob.keys() == [prefix_key(pfx.tokens)]
+    got = store.fetch_prefix(list(pfx.tokens), max_seq_len=64)
+    assert got is not None and got.tokens == pfx.tokens
+    _assert_prefix_bit_exact(pfx, got, 18)
+    st = store.stats()
+    assert st["prefix_demotes"] == 1 and st["prefix_promotes"] == 1
+
+
+def test_corrupt_blob_quarantined_not_adopted():
+    store = TieredKVStore(host=HostKVStore(cap_bytes=0),
+                          blob=InProcBlobStore())
+    pfx = _synth_prefix(18)
+    store.demote_prefix(pfx, block_size=16)
+    store.flush()
+    key = prefix_key(pfx.tokens)
+    payload = store.blob.get(key)
+    store.blob.put(key, payload[:-1] + bytes([payload[-1] ^ 0x01]))
+    # CRC mismatch -> the blob is deleted and the caller re-prefills.
+    assert store.fetch_prefix(list(pfx.tokens), max_seq_len=64) is None
+    assert store.blob.keys() == []
+    assert store.stats()["prefix_promotes"] == 0
+
+
+def test_session_resume_consumes_only_on_proper_prefix():
+    store = TieredKVStore(blob=InProcBlobStore())
+    pfx = _synth_prefix(16)
+    from llmss_tpu.serve.kvstore import blocks_from_prefix
+
+    blocks, n = blocks_from_prefix(pfx, 16)
+    store.park_session("s1", list(pfx.tokens), blocks, 16)
+    # An edited-history turn (mismatched prompt) leaves the blob parked.
+    assert store.resume_session("s1", token_ids=[9] * 20) is None
+    assert store.resume_session("s1", token_ids=list(pfx.tokens)) is None
+    good = list(pfx.tokens) + [77, 78]
+    got = store.resume_session("s1", token_ids=good)
+    assert got is not None and got[0] == list(pfx.tokens)
+    # Consumed: the resumed row's KV diverges immediately, so a second
+    # resume must re-prefill instead of adopting a stale copy.
+    assert store.resume_session("s1", token_ids=good) is None
+    assert store.stats()["sessions_resumed"] == 1
+
+
+# -- real engine: stream identity + refcounts --------------------------------
+
+
+import jax  # noqa: E402
+
+from llmss_tpu.analysis import CompileGuard  # noqa: E402
+from llmss_tpu.engine import DecodeEngine, GenerationParams  # noqa: E402
+from llmss_tpu.engine.scheduler import ContinuousBatcher  # noqa: E402
+from llmss_tpu.models.common import DecoderConfig  # noqa: E402
+from llmss_tpu.models.decoder import init_params  # noqa: E402
+from llmss_tpu.parallel import MeshPlan, make_mesh  # noqa: E402
+from llmss_tpu.serve.consumer import ContinuousWorker  # noqa: E402
+
+
+def _cfg():
+    return DecoderConfig(
+        model_type="llama", vocab_size=64, hidden_size=32, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=8, intermediate_size=64,
+        max_position_embeddings=64, activation="silu", norm="rmsnorm",
+        norm_eps=1e-5, mlp="swiglu", positions="rotary", rope_style="half",
+        rotary_dim=8, attn_bias=False, mlp_bias=False,
+        tie_word_embeddings=False, dtype="float32",
+    )
+
+
+@pytest.fixture(scope="module")
+def setup():
+    mesh = make_mesh(MeshPlan(dp=2, tp=4))
+    cfg = _cfg()
+    params = init_params(cfg, mesh, jax.random.key(0))
+    return cfg, mesh, params
+
+
+@pytest.fixture(scope="module")
+def paged_engine(setup):
+    cfg, mesh, params = setup
+    return DecodeEngine(
+        cfg, params, mesh, max_seq_len=64, kv_layout="paged", block_size=16,
+    )
+
+
+@pytest.fixture(scope="module")
+def dense_engine(setup):
+    cfg, mesh, params = setup
+    return DecodeEngine(cfg, params, mesh, max_seq_len=64)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("quantized", (False, True))
+def test_built_prefix_blob_roundtrip_bit_exact(setup, quantized):
+    """The real exporter path: build_prefix KV (float32 or int8+scales)
+    through the blob and back, bit-exact in every live slot."""
+    cfg, mesh, params = setup
+    engine = DecodeEngine(
+        cfg, params, mesh, max_seq_len=64, kv_layout="paged",
+        block_size=16, **({"kv_dtype": "int8"} if quantized else {}),
+    )
+    toks = list(range(1, 19))  # partial tail block
+    pfx = engine.build_prefix(toks)
+    payload = encode_prefix(pfx, engine.block_size)
+    rt = decode_prefix(payload, max_seq_len=engine.max_seq_len)
+    assert rt.tokens == tuple(toks)
+    _assert_prefix_bit_exact(pfx, rt, len(toks))
+
+
+@pytest.mark.slow
+def test_promotion_install_preserves_cow_refcounts(paged_engine,
+                                                   dense_engine):
+    """A promoted (fetched + rebuilt) prefix installs into the COW
+    registry exactly like a locally built one: rows share its block,
+    their release decrefs only their own references, and eviction after
+    the last reference frees the pool to zero — with streams matching
+    the dense engine's exact tokens."""
+    pfx_tokens = list(range(1, 21))  # 1 full block + tail
+    built = paged_engine.build_prefix(pfx_tokens)
+    store = TieredKVStore(blob=InProcBlobStore())
+    store.demote_prefix(built, paged_engine.block_size)
+    store.flush()
+    promoted = store.fetch_prefix(
+        pfx_tokens, max_seq_len=paged_engine.max_seq_len,
+    )
+    assert promoted is not None and promoted.tokens == tuple(pfx_tokens)
+
+    gen = GenerationParams(max_new_tokens=5, is_greedy=True)
+    full = [pfx_tokens + [30 + i] for i in range(2)]
+    expected = [dense_engine.generate([p], gen)[0] for p in full]
+
+    dec = ContinuousBatcher(paged_engine, rows=2)
+    results = {}
+    for i, p in enumerate(full):
+        dec.submit(
+            p, gen, lambda t, i=i: results.__setitem__(i, t),
+            req_id=str(i), prefix=promoted,
+        )
+    dec.run_until_idle()
+    for i, e in enumerate(expected):
+        assert results[i] == e, (i, results[i], e)
+    # Rows released their owned blocks; only the registry's shared
+    # full block remains...
+    assert dec.allocator.blocks_in_use == 1
+    # ...and once no row references it, eviction balances to zero —
+    # demoting the Prefix back down instead of dropping it.
+    dec.demote_cb = lambda pfx: store.demote_prefix(pfx, 16)
+    assert dec._paged_evict_idle_prefixes() == 1
+    assert dec.allocator.blocks_in_use == 0
+    store.flush()
+    assert store.stats()["prefix_demotes"] == 2
+
+
+# Turn 1 totals 20 tokens: (T-1)//16 = 1 full block parked, well under
+# the ring-wrap park guard (T-1 + chunk lag <= 64).
+_TURN1_PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+
+
+def _run_session(engine, kvstore, extra=(50, 51)):
+    """Two turns of one session through a ContinuousWorker; returns the
+    (turn1, turn2) token streams."""
+    b = InProcBroker()
+    w = ContinuousWorker(engine, b, rows=2, worker_id="w0", kvstore=kvstore)
+
+    def ask(req):
+        b.push_request(req)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            w.run_once()
+            resp = b.wait_response(req.id, timeout=0.01)
+            if resp is not None:
+                assert resp.error is None, (req.id, resp.error)
+                return resp
+        raise AssertionError(f"timeout waiting for {req.id}")
+
+    r1 = ask(GenerateRequest(
+        id="t1", token_ids=list(_TURN1_PROMPT), max_new_tokens=8,
+        is_greedy=True, session_id="s1",
+    ))
+    prompt2 = list(_TURN1_PROMPT) + list(r1.token_ids) + list(extra)
+    r2 = ask(GenerateRequest(
+        id="t2", token_ids=prompt2, max_new_tokens=6,
+        is_greedy=True, session_id="s1",
+    ))
+    return r1.token_ids, r2.token_ids
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("layout", ("dense", "paged"))
+def test_session_park_resume_stream_identity(layout, dense_engine,
+                                             paged_engine, request):
+    """The headline claim: turn 2 of a parked session seeds from the
+    parked KV (16 of its 22 prompt tokens never re-prefill) and the
+    stream is bit-identical to the never-parked run."""
+    engine = dense_engine if layout == "dense" else paged_engine
+    ref1, ref2 = _run_session(engine, None)  # pre-tiering reference
+    store = TieredKVStore(blob=InProcBlobStore())
+    got1, got2 = _run_session(engine, store)
+    assert got1 == ref1
+    assert got2 == ref2
+    st = store.stats()
+    # Both turns parked; turn 2 consumed turn 1's blob and skipped
+    # re-prefilling exactly the 16 parked tokens.
+    assert st["sessions_parked"] == 2
+    assert st["sessions_resumed"] == 1
+    assert st["reprefill_tokens_avoided"] == 16
+
+
+@pytest.mark.slow
+def test_session_resume_survives_t1_pressure(paged_engine):
+    """The parked blob spills to T2 under T1 pressure (cap 0 forces it);
+    resume fetches it back through the blob store — same identity."""
+    ref1, ref2 = _run_session(paged_engine, None)
+    blob = InProcBlobStore()
+    store = TieredKVStore(host=HostKVStore(cap_bytes=0), blob=blob)
+    got1, got2 = _run_session(paged_engine, store)
+    assert (got1, got2) == (ref1, ref2)
+    assert store.stats()["sessions_resumed"] == 1
+    assert blob.stats()["puts"] >= 1  # the park really went through T2
+
+
+@pytest.mark.slow
+def test_zero_steady_state_recompiles_with_tiering(paged_engine):
+    """Park, resume, demote, and promote reuse the engine's prewarmed
+    bucket shapes: after one warm pass, a fresh session and a fresh
+    promoted prefix of the same lengths add ZERO compile-cache entries."""
+    store = TieredKVStore(blob=InProcBlobStore())
+    b = InProcBroker()
+    w = ContinuousWorker(
+        paged_engine, b, rows=2, worker_id="w0", kvstore=store,
+    )
+
+    def ask(req):
+        b.push_request(req)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            w.run_once()
+            resp = b.wait_response(req.id, timeout=0.01)
+            if resp is not None:
+                assert resp.error is None, (req.id, resp.error)
+                return resp
+        raise AssertionError(f"timeout waiting for {req.id}")
+
+    def one_session(sid, base):
+        r1 = ask(GenerateRequest(
+            id=f"{sid}-1", token_ids=[base] * 12, max_new_tokens=8,
+            is_greedy=True, session_id=sid,
+        ))
+        ask(GenerateRequest(
+            id=f"{sid}-2",
+            token_ids=[base] * 12 + list(r1.token_ids) + [base + 1] * 2,
+            max_new_tokens=6, is_greedy=True, session_id=sid,
+        ))
+
+    def one_promotion(pfx_tokens, rid):
+        built = paged_engine.build_prefix(list(pfx_tokens))
+        store.demote_prefix(built, paged_engine.block_size)
+        store.flush()
+        w._prefixes.clear()  # force the local LRU miss -> promote path
+        ask(GenerateRequest(
+            id=rid, token_ids=list(pfx_tokens) + [9], max_new_tokens=4,
+            is_greedy=True, prefix_token_ids=list(pfx_tokens),
+        ))
+
+    # Warm: every tiering path once (park, resume, demote, promote).
+    one_session("warm", base=2)
+    one_promotion(range(1, 21), "warm-p")
+
+    guard = CompileGuard.for_engine(paged_engine)
+    # Steady state: same shapes, fresh session + fresh prefix.
+    one_session("steady", base=5)
+    one_promotion(range(21, 41), "steady-p")
+    guard.assert_no_recompiles()

@@ -50,6 +50,7 @@ from llmss_tpu.serve.handoff import DecodeWorker, PrefillWorker  # noqa: E402
 from llmss_tpu.serve.protocol import (  # noqa: E402
     SLO_CLASSES,
     GenerateRequest,
+    GenerateResponse,
 )
 from llmss_tpu.serve.supervisor import Supervisor  # noqa: E402
 from llmss_tpu.sim.invariants import (  # noqa: E402
@@ -350,6 +351,202 @@ def run_kill_mid_handoff(args):
         lost or dup or wrong or errored or report["host_errors"]
     )
     violations |= pre_host.kills < args.kills  # the fault must have fired
+    return 1 if violations else 0
+
+
+class _PromoteWorker:
+    """Chaos worker for ``--fault kill-mid-promotion``: serves scripted
+    requests through a REAL :class:`TieredKVStore`. A request carrying
+    ``prefix_token_ids`` first tries to promote the prefix out of the
+    tier store (the affinity-miss path) — which is where the store's
+    ``fault_hook`` can hard-kill the process, mid-T2-fetch. A
+    REDELIVERED request (``delivery_attempts > 1``) skips the promotion
+    path entirely and full-prefills: a request that already took a
+    worker down mid-promotion must not re-enter the same hazard window,
+    the same discipline delivery_attempts applies to poison prompts."""
+
+    def __init__(self, broker, store, counters, lock, max_seq_len=128):
+        self.broker = broker
+        self.store = store
+        self.counters = counters
+        self.lock = lock
+        self.max_seq_len = max_seq_len
+
+    def run_once(self):
+        req = self.broker.pop_request(timeout=0.02)
+        if req is None:
+            return
+        via = "full_prefill"
+        if req.prefix_token_ids and req.delivery_attempts <= 1:
+            pfx = self.store.fetch_prefix(  # fault_hook may HardKill here
+                req.prefix_token_ids, max_seq_len=self.max_seq_len,
+            )
+            if pfx is not None:
+                via = "promotion"
+        with self.lock:
+            self.counters[via] += 1
+            if req.delivery_attempts > 1:
+                self.counters["retry_full_prefill"] += 1
+        self.broker.push_response(GenerateResponse(
+            id=req.id,
+            token_ids=ScriptedEngine.expected_tokens(
+                list(req.token_ids), req.max_new_tokens,
+            ),
+        ))
+
+
+def run_kill_mid_promotion(args):
+    """Tiered-KV promotion chaos (``--fault kill-mid-promotion``).
+
+    A prefix is parked in the fleet blob tier (T2) of a real
+    ``serve/kvstore.py`` store; two workers share that T2 backend (each
+    with its own empty T1, like two real hosts). The chaos worker's
+    ``fault_hook`` hard-kills it INSIDE ``fetch_prefix`` — after the T2
+    fetch began, before the rebuilt prefix ever reached a device — for
+    the first ``--kills`` promotions. Contracts audited:
+
+    - exactly-one-terminal with exact payloads for every request (the
+      killed worker's lease rots; the visibility timeout redelivers);
+    - the parked blob survives its dead readers BIT-EXACT in T2 — a
+      promotion is a read, never a move;
+    - every redelivered request serves by full prefill (the worker
+      refuses to re-enter the promotion window for it), and promotions
+      succeed again once the kill budget is spent.
+    """
+    import numpy as np
+
+    from llmss_tpu.serve.kvstore import (
+        HostKVStore, InProcBlobStore, RedisBlobStore, TieredKVStore,
+        prefix_from_blocks,
+    )
+
+    args.workers = 2
+    prod_broker, (wb1, wb2) = build_brokers(args)
+    if args.broker == "fakeredis":
+        # Same substrate as the broker, same namespace discipline as
+        # consumer.main: the ``:kv:`` segment keeps blobs clear of
+        # queue/lease keys.
+        blob = RedisBlobStore(prod_broker._r, namespace="chaos")
+    else:
+        blob = InProcBlobStore()
+
+    # Park one shared prefix straight into T2 (cap 0: every put spills).
+    bs, n, L, Hkv, D = 16, 20, 2, 2, 4
+    pfx_tokens = [(i * 13) % 997 + 1 for i in range(n)]
+    blocks = {
+        "k": np.arange(L * 2 * bs * Hkv * D, dtype=np.float32).reshape(
+            L, 2, bs, Hkv, D,
+        ),
+        "v": -np.arange(L * 2 * bs * Hkv * D, dtype=np.float32).reshape(
+            L, 2, bs, Hkv, D,
+        ),
+        "k_scale": None,
+        "v_scale": None,
+    }
+    parked = prefix_from_blocks(pfx_tokens, blocks, max_seq_len=128)
+    seeder = TieredKVStore(host=HostKVStore(cap_bytes=0), blob=blob)
+    seeder.demote_prefix(parked, bs)
+    seeder.flush()
+
+    kills_left = [args.kills]
+    klock = threading.Lock()
+
+    def hook(stage, key):
+        if stage != "t2_get":
+            return
+        with klock:
+            if kills_left[0] > 0:
+                kills_left[0] -= 1
+                raise HardKill(f"chaos: killed mid-promotion of {key}")
+
+    chaos_store = TieredKVStore(host=HostKVStore(cap_bytes=0), blob=blob)
+    chaos_store.fault_hook = hook
+    sane_store = TieredKVStore(host=HostKVStore(cap_bytes=0), blob=blob)
+
+    counters: dict[str, int] = {
+        "promotion": 0, "full_prefill": 0, "retry_full_prefill": 0,
+    }
+    clock = threading.Lock()
+    hosts = [
+        ChaosWorkerHost(
+            lambda: _PromoteWorker(wb1, chaos_store, counters, clock),
+            respawn_delay_s=0.02,
+        ),
+        ChaosWorkerHost(
+            lambda: _PromoteWorker(wb2, sane_store, counters, clock),
+            respawn_delay_s=0.02,
+        ),
+    ]
+
+    reqs = [
+        GenerateRequest(
+            token_ids=list(pfx_tokens) + [i % 1000 + 1, i % 7 + 1],
+            prefix_token_ids=list(pfx_tokens),
+            max_new_tokens=4,
+            deadline_ts=time.time() + args.deadline_s,
+        )
+        for i in range(args.requests)
+    ]
+    for r in reqs:
+        prod_broker.push_request(r)
+    # The chaos worker must spend its kill budget on first-attempt
+    # promotions before the healthy worker races it to an empty queue
+    # (run_burst's discipline).
+    hosts[0].start()
+    spend_deadline = time.time() + args.deadline_s / 2
+    while time.time() < spend_deadline:
+        with klock:
+            if kills_left[0] <= 0:
+                break
+        time.sleep(0.01)
+    hosts[1].start()
+
+    results = collect_responses(prod_broker, reqs, timeout_s=args.deadline_s)
+    for h in hosts:
+        h.stop()
+
+    violation = None
+    successes = 0
+    try:
+        successes = audit_exactly_once(reqs, results, broker=prod_broker)
+    except AssertionError as e:
+        violation = str(e)
+
+    # The blob must still be promotable, bit-exact, after its readers
+    # died mid-fetch.
+    check = TieredKVStore(host=HostKVStore(cap_bytes=0), blob=blob)
+    survivor = check.fetch_prefix(pfx_tokens, max_seq_len=128)
+    blob_intact = survivor is not None and all(
+        np.array_equal(
+            np.asarray(getattr(survivor, f))[:, :n],
+            np.asarray(getattr(parked, f))[:, :n],
+        )
+        for f in ("k", "v")
+    )
+
+    kills = hosts[0].kills
+    stats = prod_broker.delivery_stats()
+    report = {
+        "fault": "kill-mid-promotion",
+        "requests": args.requests,
+        "ok": successes,
+        "kills": kills,
+        "promotions": counters["promotion"],
+        "full_prefills": counters["full_prefill"],
+        "retry_full_prefills": counters["retry_full_prefill"],
+        "blob_intact_in_t2": blob_intact,
+        "delivery": stats,
+        "host_errors": [h.error for h in hosts if h.error],
+        "violation": violation,
+    }
+    print(json.dumps(report))
+    violations = bool(violation or report["host_errors"])
+    violations |= kills < args.kills          # the fault must have fired
+    violations |= not blob_intact             # promotion is a read, not a move
+    # Every kill orphaned exactly one request; each must have come back
+    # through redelivery and served by full prefill.
+    violations |= counters["retry_full_prefill"] < args.kills
+    violations |= counters["promotion"] < 1   # the path works post-budget
     return 1 if violations else 0
 
 
@@ -944,13 +1141,15 @@ def main(argv=None):
     p.add_argument("--batch-size", type=int, default=1)
     p.add_argument("--fault",
                    choices=("drain", "hang", "nan", "kill-mid-handoff",
-                            "burst", "flap"),
+                            "kill-mid-promotion", "burst", "flap"),
                    default=None,
                    help="run a deterministic scripted-failure scenario "
                         "instead of the random kill/drop fleet")
     p.add_argument("--kills", type=int, default=3,
                    help="kill-mid-handoff: how many exports get the "
-                        "prefill replica killed before push_handoff")
+                        "prefill replica killed before push_handoff; "
+                        "kill-mid-promotion: how many tier-store "
+                        "promotions die mid-T2-fetch")
     p.add_argument("--scenario", default=None,
                    help="replay a sim scenario file's fault plane against "
                         "a real in-proc fleet (parity with llmss_tpu/sim)")
@@ -967,6 +1166,8 @@ def main(argv=None):
         return run_scenario(args)
     if args.fault == "kill-mid-handoff":
         return run_kill_mid_handoff(args)
+    if args.fault == "kill-mid-promotion":
+        return run_kill_mid_promotion(args)
     if args.fault == "burst":
         return run_burst(args)
     if args.fault == "flap":

@@ -89,13 +89,27 @@ def _prefix_tokens(ph: str) -> list[int]:
 
 
 def synthesize_request(
-    row: dict, index: int = 0, prefixes: dict | None = None
+    row: dict, index: int = 0, prefixes: dict | None = None,
+    sessions: dict | None = None,
 ) -> GenerateRequest:
-    """One replayable request from one workload row."""
+    """One replayable request from one workload row.
+
+    ``sessions`` (session_id -> that session's last synthesized prompt)
+    makes replayed chat traffic *structurally* multi-turn: turn N's
+    prompt EXTENDS turn N-1's, the way real conversation history does —
+    which is what exercises session parking and prefix tiering at
+    replay. Captures record only lengths, so the extension is padded
+    deterministically to the captured prompt_len.
+    """
     plen = int(row.get("prompt_len") or 16)
+    sess = row.get("session_id")
+    base: list[int] = []
+    if sess and sessions is not None:
+        base = list(sessions.get(str(sess)) or [])
+    fresh = max(plen - len(base), 1)
     req = GenerateRequest(
         id=str(row.get("req_id") or f"wl-{index}"),
-        token_ids=[(index * 7 + j) % VOCAB for j in range(plen)],
+        token_ids=base + [(index * 7 + j) % VOCAB for j in range(fresh)],
         max_new_tokens=int(row.get("max_new_tokens") or 20),
     )
     # Older captures carried a "priority" placeholder instead; either key
@@ -104,10 +118,14 @@ def synthesize_request(
     if cls in SLO_CLASSES:
         req.slo_class = cls
     # session_id is optional in the capture (older workload files predate
-    # it); present, it restores per-session arrival structure.
-    sess = row.get("session_id")
+    # it); present, it restores per-session arrival structure — and the
+    # turn ordinal, when the capture recorded one.
     if sess:
         req.session_id = str(sess)
+        if row.get("turn") is not None:
+            req.turn = int(row["turn"])
+        if sessions is not None:
+            sessions[str(sess)] = list(req.token_ids)
     ph = row.get("prefix_hash")
     if ph is not None:
         if prefixes is None:
@@ -127,8 +145,15 @@ def replay(workload: dict, submit, speed: float = 0.0) -> int:
     """
     if workload.get("format") != trace.WORKLOAD_FORMAT:
         raise ValueError(f"not a {trace.WORKLOAD_FORMAT} payload")
-    rows = sorted(workload.get("requests", []), key=lambda r: r["arrival_s"])
+    # Secondary sort on the turn ordinal: simultaneous arrivals within a
+    # session must still replay in turn order (turn N's prompt extends
+    # turn N-1's).
+    rows = sorted(
+        workload.get("requests", []),
+        key=lambda r: (r["arrival_s"], r.get("turn") or 0),
+    )
     prefixes: dict = {}
+    sessions: dict = {}
     t0 = time.monotonic()
     n = 0
     for i, row in enumerate(rows):
@@ -136,7 +161,7 @@ def replay(workload: dict, submit, speed: float = 0.0) -> int:
             lag = row["arrival_s"] / speed - (time.monotonic() - t0)
             if lag > 0:
                 time.sleep(lag)
-        submit(synthesize_request(row, i, prefixes))
+        submit(synthesize_request(row, i, prefixes, sessions))
         n += 1
     return n
 
@@ -154,6 +179,32 @@ def summarize(workload: dict) -> dict:
         "max_new_mean": round(sum(news) / len(news), 1) if news else 0,
         "distinct_prefixes": len(
             {r["prefix_hash"] for r in rows if r.get("prefix_hash")}
+        ),
+        **_session_shape(rows),
+    }
+
+
+def _session_shape(rows: list[dict]) -> dict:
+    """Multi-turn summary block — empty for captures without sessions."""
+    turns: dict[str, int] = {}
+    thinks: list[float] = []
+    for r in rows:
+        sid = r.get("session_id")
+        if not sid:
+            continue
+        turns[sid] = turns.get(sid, 0) + 1
+        if r.get("think_s") is not None:
+            thinks.append(float(r["think_s"]))
+    if not turns:
+        return {}
+    return {
+        "sessions": len(turns),
+        "turns_per_session_mean": round(
+            sum(turns.values()) / len(turns), 2
+        ),
+        "turns_per_session_max": max(turns.values()),
+        "think_s_mean": (
+            round(sum(thinks) / len(thinks), 3) if thinks else None
         ),
     }
 
